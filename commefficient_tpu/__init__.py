@@ -1,0 +1,27 @@
+"""commefficient_tpu — a TPU-native communication-efficient federated training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+``pursueorigin/CommEfficient`` (the FetchSGD codebase): per-worker gradient
+CountSketch compression, top-k sparsification, error-feedback and momentum
+(including momentum/error carried *in sketch space*), thousands of non-IID
+virtual clients multiplexed over a device mesh, and end-to-end CV + NLP
+workloads.
+
+Where the reference runs a parameter-server process plus one OS process per
+GPU communicating through POSIX shared memory (reference:
+``CommEfficient/fed_aggregator.py``, ``CommEfficient/fed_worker.py``), this
+framework expresses the entire federated round as ONE jitted JAX program over
+a ``jax.sharding.Mesh``: workers are ``shard_map`` shards, sketch aggregation
+is a ``lax.psum`` over ICI (exact, because Count Sketch is linear), and server
+momentum/error state lives in HBM as replicated arrays.
+
+Package layout:
+  ops/       CountSketch + top-k + flat-param primitives (L0)
+  models/    ResNet-9, FixupResNet, GPT-2 in flax (L1)
+  parallel/  mesh helpers, the federated round engine, ring attention (L2+L3)
+  data/      federated datasets + client samplers (L4)
+  train/     cv_train / gpt2_train entry points (L5)
+  utils/     config, schedules, logging (L6)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Compression primitives: CountSketch, top-k sparsification, flat-param utils."""
+
+from commefficient_tpu.ops.countsketch import (
+    CountSketch,
+    sketch_vec,
+    sketch_add_vec,
+    unsketch,
+    estimate_all,
+    estimate_at,
+    l2_estimate,
+)
+from commefficient_tpu.ops.topk import topk_sparsify, topk_dense, mask_out_indices
+from commefficient_tpu.ops.param_utils import (
+    ravel_params,
+    make_unraveler,
+    clip_by_global_norm,
+)
+
+__all__ = [
+    "CountSketch",
+    "sketch_vec",
+    "sketch_add_vec",
+    "unsketch",
+    "estimate_all",
+    "estimate_at",
+    "l2_estimate",
+    "topk_sparsify",
+    "topk_dense",
+    "mask_out_indices",
+    "ravel_params",
+    "make_unraveler",
+    "clip_by_global_norm",
+]

@@ -1,0 +1,46 @@
+"""Flat-parameter-vector utilities.
+
+The unit of compression in the reference is a single length-D float vector of
+all model parameters (``utils.py``: ``get_param_vec``/``set_param_vec``/
+``get_grad`` ~L200-320). JAX gives us the same thing functionally via
+``ravel_pytree``; these helpers pin down the convention and add the
+global-norm clip used on per-client gradients (``utils.py clip_grad`` and
+``fed_worker.py`` ~L380-420, flag ``--max_grad_norm``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def ravel_params(params: Any) -> tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Flatten a param pytree to a float32 [D] vector plus its unraveler.
+
+    ``get_param_vec`` analog (utils.py ~L200-230). The returned unraveler is a
+    pure function usable inside jit.
+    """
+    vec, unravel = ravel_pytree(params)
+    return vec.astype(jnp.float32), unravel
+
+
+def make_unraveler(params: Any) -> tuple[int, Callable[[jnp.ndarray], Any]]:
+    """Return (D, unravel_fn) for a parameter pytree without keeping the vec."""
+    vec, unravel = ravel_pytree(params)
+    return int(vec.size), unravel
+
+
+def clip_by_global_norm(vec: jnp.ndarray, max_norm: float | None) -> jnp.ndarray:
+    """Scale ``vec`` so its L2 norm is at most ``max_norm`` (None = no clip).
+
+    Matches torch.nn.utils.clip_grad_norm_ semantics used per client in
+    fed_worker.py ~L380-420.
+    """
+    if max_norm is None:
+        return vec
+    norm = jnp.linalg.norm(vec)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-12))
+    return vec * scale

@@ -1,0 +1,36 @@
+"""Top-k sparsification primitives.
+
+The reference sparsifies with ``torch.topk`` over the flat gradient/error
+vector (``fed_worker.py`` ~L200-240 for local_topk, ``fed_aggregator.py``
+``_server_helper_true_topk`` ~L440-480 for server-side top-k). Here the same
+semantics are ``jax.lax.top_k`` over the flat [d] vector, with an optional
+``jax.lax.approx_max_k`` fast path for very large d (TPU-native, documented
+recall guarantees) that callers must opt into.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def topk_sparsify(v: jnp.ndarray, k: int, *, approx: bool = False):
+    """Return (values [k], indices [k]) of the k largest-|.| entries of flat v."""
+    mag = jnp.abs(v)
+    if approx:
+        _, idx = jax.lax.approx_max_k(mag, k)
+    else:
+        _, idx = jax.lax.top_k(mag, k)
+    return v[idx], idx
+
+
+def topk_dense(v: jnp.ndarray, k: int, *, approx: bool = False) -> jnp.ndarray:
+    """Dense [d] vector keeping only the top-k entries of v by magnitude."""
+    vals, idx = topk_sparsify(v, k, approx=approx)
+    return jnp.zeros_like(v).at[idx].set(vals)
+
+
+def mask_out_indices(v: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Zero the given coordinates — the error-feedback "forget what was sent"
+    step (``Ve[hh]=0`` in fed_aggregator.py ~L440-480)."""
+    return v.at[idx].set(0.0)

@@ -1,0 +1,14 @@
+"""Config, schedules, logging — the L6 utility layer."""
+
+from commefficient_tpu.utils.config import Config, parse_args
+from commefficient_tpu.utils.schedule import piecewise_linear_lr
+from commefficient_tpu.utils.logging import TableLogger, Timer, MetricsWriter
+
+__all__ = [
+    "Config",
+    "parse_args",
+    "piecewise_linear_lr",
+    "TableLogger",
+    "Timer",
+    "MetricsWriter",
+]

@@ -1,0 +1,143 @@
+"""Typed configuration system.
+
+The reference threads a single flat ``argparse.Namespace`` through every
+layer (``utils.py parse_args`` ~L20-180, SURVEY.md §2 "Config system"). We
+keep the *flag names* for run-command parity (``--mode``, ``--k``,
+``--num_rows``, ...), but back them with a frozen dataclass so the config is
+hashable (usable as a static jit argument), documented, and validated at
+construction instead of at first crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+MODES = ("uncompressed", "sketch", "true_topk", "local_topk", "fedavg")
+ERROR_TYPES = ("none", "local", "virtual")
+
+
+@dataclass(frozen=True)
+class Config:
+    """All knobs of a federated run. Field names follow the reference flags."""
+
+    # --- compression / mode (reference: --mode, --k, --num_rows, --num_cols,
+    # --num_blocks) ---
+    mode: str = "uncompressed"
+    k: int = 50_000  # sparsity of the extracted update (sketch/topk modes)
+    num_rows: int = 5  # sketch rows r
+    num_cols: int = 500_000  # sketch columns c
+    num_blocks: int = 1  # memory chunking for full-d unsketch estimates
+    do_topk_down: bool = False  # top-k compress the downlink too
+
+    # --- momentum / error feedback (reference: --virtual_momentum,
+    # --local_momentum, --error_type) ---
+    virtual_momentum: float = 0.0  # server-side momentum factor rho
+    local_momentum: float = 0.0  # per-client momentum factor
+    error_type: str = "none"  # where error feedback lives
+
+    # --- federation shape (reference: --num_clients, --num_workers,
+    # --num_devices, --local_batch_size, --iid / --non_iid) ---
+    num_clients: int = 16  # total virtual clients
+    num_workers: int = 8  # participating clients per round
+    num_devices: int = 1  # mesh size the workers are multiplexed onto
+    local_batch_size: int = 8  # per-client batch per round
+    iid: bool = True  # IID vs pathological-non-IID client sharding
+
+    # --- fedavg (reference: --num_local_iters, --local_lr) ---
+    num_local_iters: int = 1
+    local_lr: float = 0.1
+
+    # --- optimization (reference: --lr_scale, --pivot_epoch, --num_epochs,
+    # --max_grad_norm, --weight_decay, --momentum_type) ---
+    lr_scale: float = 0.4
+    pivot_epoch: int = 5
+    num_epochs: int = 24
+    max_grad_norm: Optional[float] = None
+    weight_decay: float = 5e-4
+    momentum_dampening: bool = False  # zero momentum at HH coords after send
+
+    # --- model / dataset (reference: --model, --dataset_name,
+    # --dataset_dir) ---
+    model: str = "resnet9"
+    dataset_name: str = "cifar10"
+    dataset_dir: str = "./data"
+    num_classes: int = 10
+
+    # --- GPT-2 workload (reference: --model_checkpoint, --num_candidates,
+    # --max_history, --lm_coef, --mc_coef) ---
+    model_checkpoint: str = "gpt2"
+    num_candidates: int = 2
+    max_history: int = 2
+    lm_coef: float = 1.0
+    mc_coef: float = 1.0
+    max_seq_len: int = 256
+
+    # --- privacy (reference: DP clip+noise flags, fed_worker.py ~L380-420) ---
+    dp_noise_multiplier: float = 0.0
+
+    # --- misc (reference: --seed, --mesh shape additions are ours) ---
+    seed: int = 42
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0  # rounds between checkpoints; 0 = off
+    resume: bool = False
+    tensorboard: bool = False
+    logdir: str = "runs"
+    # TPU-native extensions (no reference equivalent): extra mesh axes.
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {self.mode!r}")
+        if self.error_type not in ERROR_TYPES:
+            raise ValueError(
+                f"error_type must be one of {ERROR_TYPES}, got {self.error_type!r}"
+            )
+        if self.num_workers % self.num_devices != 0:
+            raise ValueError(
+                "num_workers must be divisible by num_devices "
+                f"({self.num_workers} % {self.num_devices} != 0)"
+            )
+        if self.num_clients < self.num_workers:
+            raise ValueError("num_clients must be >= num_workers")
+
+    @property
+    def clients_per_device(self) -> int:
+        return self.num_workers // self.num_devices
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def _add_flags(p: argparse.ArgumentParser) -> None:
+    """One flag per Config field, reference-compatible names."""
+    for f in dataclasses.fields(Config):
+        name = "--" + f.name
+        default = f.default
+        ann = str(f.type)
+        if f.type in ("bool", bool) or isinstance(default, bool):
+            p.add_argument(
+                name,
+                type=lambda s: s.lower() in ("1", "true", "yes"),
+                nargs="?",
+                const=True,
+                default=default,
+            )
+        elif "Optional" in ann or "None" in ann:
+            inner = float if "float" in ann else (int if "int" in ann else str)
+            p.add_argument(name, type=inner, default=default)
+        else:
+            p.add_argument(name, type=type(default), default=default)
+
+
+def parse_args(argv=None, **overrides) -> Config:
+    """CLI -> Config. The analog of the reference's ``utils.parse_args``."""
+    p = argparse.ArgumentParser(description="commefficient_tpu")
+    _add_flags(p)
+    ns = p.parse_args(argv)
+    d = vars(ns)
+    d.update(overrides)
+    return Config(**d)

@@ -1,0 +1,97 @@
+"""Console + TensorBoard logging.
+
+Rebuilds the reference's observability layer (SURVEY.md §5: cifar10-fast
+style ``TableLogger``/``Timer`` plus a TensorBoard ``SummaryWriter`` rooted
+at an args-derived run dir — ``utils.py make_logdir`` ~L320-350,
+``TableLogger``/``Timer`` ~L350-400). TensorBoard is optional: if no writer
+backend is importable we degrade to console-only rather than crashing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class Timer:
+    """Accumulating phase timer: ``t()`` returns seconds since last call."""
+
+    def __init__(self):
+        self._last = time.perf_counter()
+        self.total = 0.0
+
+    def __call__(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        self.total += dt
+        return dt
+
+
+class TableLogger:
+    """Aligned console table, one row per epoch (cifar10-fast style)."""
+
+    def __init__(self, width: int = 12):
+        self.width = width
+        self._keys: Optional[list[str]] = None
+
+    def append(self, row: dict) -> None:
+        if self._keys is None:
+            self._keys = list(row.keys())
+            print(" | ".join(f"{k:>{self.width}s}" for k in self._keys))
+        cells = []
+        for k in self._keys:
+            v = row.get(k, "")
+            if isinstance(v, float):
+                cells.append(f"{v:>{self.width}.4f}")
+            else:
+                cells.append(f"{str(v):>{self.width}s}")
+        print(" | ".join(cells), flush=True)
+
+
+def make_logdir(cfg) -> str:
+    """Run-dir name derived from the salient config fields (the reference
+    derives it from args the same way)."""
+    tag = f"{cfg.dataset_name}_{cfg.model}_{cfg.mode}_w{cfg.num_workers}_s{cfg.seed}"
+    return os.path.join(cfg.logdir, tag + "_" + time.strftime("%Y%m%d-%H%M%S"))
+
+
+class MetricsWriter:
+    """Scalar metrics sink: TensorBoard if available, always a JSONL file.
+
+    Scalar names match the reference's (train/loss, val/loss, val/acc, lr,
+    ...) so curves are directly comparable.
+    """
+
+    def __init__(self, logdir: str, enable_tensorboard: bool = False):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
+        self._tb = None
+        if enable_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+                self._tb = SummaryWriter(logdir)
+            except Exception:
+                self._tb = None
+
+    def scalar(self, name: str, value: float, step: int) -> None:
+        self._jsonl.write(
+            json.dumps({"name": name, "value": float(value), "step": int(step)}) + "\n"
+        )
+        if self._tb is not None:
+            self._tb.add_scalar(name, float(value), int(step))
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()

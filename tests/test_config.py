@@ -1,0 +1,54 @@
+"""Config + schedule unit tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.utils import Config, parse_args, piecewise_linear_lr
+
+
+def test_defaults_valid():
+    cfg = Config()
+    assert cfg.mode == "uncompressed"
+    assert cfg.clients_per_device == 8
+
+
+def test_cli_roundtrip():
+    cfg = parse_args(
+        [
+            "--mode", "sketch",
+            "--k", "100",
+            "--num_rows", "3",
+            "--num_cols", "1000",
+            "--virtual_momentum", "0.9",
+            "--error_type", "virtual",
+            "--num_clients", "40",
+            "--num_workers", "4",
+            "--iid", "false",
+        ]
+    )
+    assert cfg.mode == "sketch" and cfg.k == 100 and cfg.num_rows == 3
+    assert cfg.virtual_momentum == 0.9 and cfg.error_type == "virtual"
+    assert not cfg.iid
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        Config(mode="bogus")
+    with pytest.raises(ValueError):
+        Config(num_workers=3, num_devices=2)
+    with pytest.raises(ValueError):
+        Config(num_clients=2, num_workers=8)
+
+
+def test_piecewise_linear_shape():
+    kw = dict(steps_per_epoch=10, pivot_epoch=5, num_epochs=20, lr_scale=0.4)
+    lrs = np.array(
+        [float(piecewise_linear_lr(jnp.asarray(s), **kw)) for s in range(200)]
+    )
+    peak = lrs.argmax()
+    assert abs(peak - 49) <= 1  # peak at pivot_epoch
+    assert lrs[0] < 0.01 and lrs[-1] < 0.01  # ~0 at both ends
+    np.testing.assert_allclose(lrs.max(), 0.4, atol=0.01)
+    assert np.all(np.diff(lrs[: peak + 1]) >= -1e-9)  # monotone up
+    assert np.all(np.diff(lrs[peak:]) <= 1e-9)  # monotone down

@@ -1,0 +1,146 @@
+"""Property tests for the Count Sketch library.
+
+Ports the contract of the reference's csvec test suite
+(``nikitaivkin/csh::test_csvec.py``, per SURVEY.md §4): heavy-hitter
+recovery, linearity, l2 estimation — plus hash-quality and determinism checks
+specific to our stateless hashing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.ops import (
+    CountSketch,
+    sketch_vec,
+    unsketch,
+    estimate_all,
+    l2_estimate,
+)
+from commefficient_tpu.ops.countsketch import estimate_at, sketch_add_vec
+
+D, C, R = 10_000, 2_000, 5
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return CountSketch(d=D, c=C, r=R, num_blocks=4, seed=7)
+
+
+def planted_vector(d, k, rng, heavy=100.0, noise=1.0):
+    """Dense vector with k heavy coordinates over light gaussian noise."""
+    v = rng.normal(0, noise, size=d).astype(np.float32)
+    idx = rng.choice(d, size=k, replace=False)
+    signs = rng.choice([-1.0, 1.0], size=k)
+    v[idx] += heavy * signs
+    return jnp.asarray(v), np.asarray(idx)
+
+
+def test_recovers_planted_heavy_hitters(spec):
+    rng = np.random.default_rng(0)
+    v, hh = planted_vector(D, 20, rng)
+    table = sketch_vec(spec, v)
+    rec = unsketch(spec, table, k=20)
+    rec_idx = set(np.nonzero(np.asarray(rec))[0].tolist())
+    assert set(hh.tolist()) <= rec_idx
+    # recovered values close to true values on the heavy coords
+    np.testing.assert_allclose(
+        np.asarray(rec)[hh], np.asarray(v)[hh], rtol=0.15, atol=2.0
+    )
+
+
+def test_linearity(spec):
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    t_sum = sketch_vec(spec, a + b)
+    t_parts = sketch_vec(spec, a) + sketch_vec(spec, b)
+    np.testing.assert_allclose(np.asarray(t_sum), np.asarray(t_parts), rtol=1e-4, atol=1e-3)
+
+
+def test_sketch_add_vec_matches_fresh(spec):
+    rng = np.random.default_rng(5)
+    a = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    t = sketch_add_vec(spec, sketch_vec(spec, a), b)
+    np.testing.assert_allclose(
+        np.asarray(t), np.asarray(sketch_vec(spec, a + b)), rtol=1e-4, atol=1e-3
+    )
+
+
+def test_l2_estimate(spec):
+    rng = np.random.default_rng(2)
+    v = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    est = float(l2_estimate(spec, sketch_vec(spec, v)))
+    true = float(jnp.linalg.norm(v))
+    assert abs(est - true) / true < 0.25
+
+
+def test_estimate_all_matches_estimate_at(spec):
+    rng = np.random.default_rng(3)
+    v = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    table = sketch_vec(spec, v)
+    full = estimate_all(spec, table)
+    idx = jnp.asarray(rng.choice(D, size=100, replace=False).astype(np.int32))
+    np.testing.assert_allclose(
+        np.asarray(full)[np.asarray(idx)],
+        np.asarray(estimate_at(spec, table, idx)),
+        rtol=1e-5,
+    )
+
+
+def test_num_blocks_invariance():
+    """Blockwise estimation is a memory knob, not a semantics knob."""
+    rng = np.random.default_rng(4)
+    v = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    tables = {}
+    for nb in (1, 4, 7):
+        sp = CountSketch(d=D, c=C, r=R, num_blocks=nb, seed=7)
+        tables[nb] = np.asarray(estimate_all(sp, sketch_vec(sp, v)))
+    np.testing.assert_allclose(tables[1], tables[4], rtol=1e-5)
+    np.testing.assert_allclose(tables[1], tables[7], rtol=1e-5)
+
+
+def test_determinism_across_instances(spec):
+    """Same seed => same hashes => same tables (the property that lets server
+    and workers agree without communicating hash state)."""
+    v = jnp.ones(D, dtype=jnp.float32)
+    spec2 = CountSketch(d=D, c=C, r=R, num_blocks=4, seed=7)
+    np.testing.assert_array_equal(
+        np.asarray(sketch_vec(spec, v)), np.asarray(sketch_vec(spec2, v))
+    )
+    spec3 = CountSketch(d=D, c=C, r=R, num_blocks=4, seed=8)
+    assert not np.array_equal(
+        np.asarray(sketch_vec(spec, v)), np.asarray(sketch_vec(spec3, v))
+    )
+
+
+def test_hash_quality(spec):
+    """Buckets roughly uniform; signs roughly balanced; rows decorrelated."""
+    idx = jnp.arange(D, dtype=jnp.uint32)
+    keys = spec._row_keys()
+    all_buckets = []
+    for rk in np.asarray(keys):
+        b, s = spec.buckets_signs(idx, jnp.uint32(rk))
+        b, s = np.asarray(b), np.asarray(s)
+        counts = np.bincount(b, minlength=C)
+        assert counts.max() < 5 * (D / C)  # no catastrophically hot bucket
+        assert abs(s.mean()) < 0.05  # balanced signs
+        all_buckets.append(b)
+    for i in range(R):
+        for j in range(i + 1, R):
+            assert np.mean(all_buckets[i] == all_buckets[j]) < 5.0 / C * 3 + 0.01
+
+
+def test_jit_and_grad_safety(spec):
+    """sketch/unsketch compile under jit and work on traced values."""
+    v = jnp.ones(D, dtype=jnp.float32)
+
+    @jax.jit
+    def roundtrip(v):
+        return unsketch(spec, sketch_vec(spec, v), k=10)
+
+    out = roundtrip(v)
+    assert out.shape == (D,)
+    assert int(jnp.sum(out != 0)) <= 10
