@@ -1,0 +1,115 @@
+"""FixupResNet — ResNet-v1 with Fixup initialization instead of BatchNorm.
+
+Behavioral spec from the reference's ``CommEfficient/models/fixup_resnet.py``
+~L1-250 (SURVEY.md §2 "FixupResNet"): the reference carries this model
+because BatchNorm statistics don't survive federated averaging; Fixup
+(Zhang et al. 2019) removes normalization entirely by (a) rescaling residual
+branches at init by L^(-1/(2m-2)), (b) zero-initializing the last conv of
+every branch, and (c) adding scalar bias/scale parameters around each conv.
+
+The result is a model whose entire state is its parameter pytree — exactly
+what the flat-vector compression pipeline wants. NHWC, bf16 on the MXU,
+float32 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.nn.initializers import variance_scaling, zeros
+
+
+def _scaled_he(scale: float):
+    """He-normal init scaled by ``scale`` (Fixup's L^(-1/(2m-2)) factor)."""
+    return variance_scaling(2.0 * scale * scale, "fan_in", "truncated_normal")
+
+
+class _ScalarBias(nn.Module):
+    """A single learned scalar added to the whole tensor (Fixup's biasNa/Nb)."""
+
+    @nn.compact
+    def __call__(self, x):
+        b = self.param("bias", zeros, (1,))
+        return x + b[0]
+
+
+class FixupBottleneck(nn.Module):
+    """3-conv bottleneck branch with Fixup biases/scale; m=3 convs per branch."""
+
+    features: int  # bottleneck width; output is 4*features
+    stride: int = 1
+    branch_scale: float = 1.0  # L^(-1/(2m-2))
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        needs_proj = x.shape[-1] != 4 * self.features or self.stride != 1
+        h = _ScalarBias()(x)
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(
+                4 * self.features, (1, 1), strides=self.stride, use_bias=False,
+                dtype=self.dtype, kernel_init=_scaled_he(1.0),
+            )(h)
+        y = nn.Conv(
+            self.features, (1, 1), use_bias=False, dtype=self.dtype,
+            kernel_init=_scaled_he(self.branch_scale),
+        )(h)
+        y = nn.relu(_ScalarBias()(y))
+        y = nn.Conv(
+            self.features, (3, 3), strides=self.stride, padding=1,
+            use_bias=False, dtype=self.dtype,
+            kernel_init=_scaled_he(self.branch_scale),
+        )(_ScalarBias()(y))
+        y = nn.relu(_ScalarBias()(y))
+        y = nn.Conv(
+            4 * self.features, (1, 1), use_bias=False, dtype=self.dtype,
+            kernel_init=zeros,  # Fixup: last conv of every branch starts at 0
+        )(_ScalarBias()(y))
+        scale = self.param("scale", nn.initializers.ones, (1,))
+        y = y * scale[0]
+        y = _ScalarBias()(y)
+        return nn.relu(y + shortcut)
+
+
+class FixupResNet(nn.Module):
+    """ImageNet-shape Fixup ResNet (224x224 NHWC in, logits out).
+
+    Reference: ``FixupResNet`` / ``fixup_resnet50`` in
+    ``CommEfficient/models/fixup_resnet.py`` ~L1-250.
+    """
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        num_blocks = sum(self.stage_sizes)
+        branch_scale = float(num_blocks) ** (-1.0 / (2 * 3 - 2))  # m=3
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), strides=2, padding=3, use_bias=False,
+            dtype=self.dtype, kernel_init=_scaled_he(1.0),
+        )(x)
+        x = nn.relu(_ScalarBias()(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                x = FixupBottleneck(
+                    self.width * (2**stage), stride=stride,
+                    branch_scale=branch_scale, dtype=self.dtype,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = _ScalarBias()(x)
+        # Fixup: classification head weights start at zero.
+        x = nn.Dense(self.num_classes, dtype=self.dtype, kernel_init=zeros)(x)
+        return x.astype(jnp.float32)
+
+
+def fixup_resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> FixupResNet:
+    return FixupResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,158 @@
+"""GPT-2 with a double head (LM + multiple-choice), written for TPU.
+
+The reference imports HuggingFace's torch ``GPT2DoubleHeadsModel`` and trains
+it on PersonaChat (``gpt2_train.py`` ~L60-140, SURVEY.md §2 "GPT-2 workload
+glue"): LM head over the vocabulary plus a multiple-choice head that scores
+each candidate continuation from the hidden state at its last token. This is
+a ground-up flax implementation of the same architecture (GPT-2 small by
+default, D ~= 124M), not a port of HF code:
+
+* bf16 activations / fp32 params; attention scores accumulated in fp32.
+* a pluggable ``attn_fn`` hook: the default is dense causal attention; the
+  sequence-parallel path swaps in ring attention
+  (``commefficient_tpu.parallel.ring_attention``) without touching the model.
+* weight tying between token embedding and LM head (as in GPT-2).
+* HF-compatible config field names so checkpoints can be mapped over if
+  GPT-2 weights are available on disk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+
+
+def dense_causal_attention(q, k, v):
+    """[B, H, T, hd] q/k/v -> [B, H, T, hd]; fp32 softmax, causal mask."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    t = scores.shape[-1]
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: GPT2Config
+    attn_fn: Callable = staticmethod(dense_causal_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        B, T, E = x.shape
+        hd = E // c.n_head
+        init = nn.initializers.normal(c.initializer_range)
+        qkv = nn.Dense(3 * E, dtype=c.dtype, kernel_init=init, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda u: u.reshape(B, T, c.n_head, hd).transpose(0, 2, 1, 3)
+        out = self.attn_fn(split(q), split(k), split(v))
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, E)
+        return nn.Dense(E, dtype=c.dtype, kernel_init=init, name="c_proj")(out)
+
+
+class MLP(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        init = nn.initializers.normal(c.initializer_range)
+        h = nn.Dense(4 * c.n_embd, dtype=c.dtype, kernel_init=init, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(c.n_embd, dtype=c.dtype, kernel_init=init, name="c_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+    attn_fn: Callable = staticmethod(dense_causal_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        ln = lambda name: nn.LayerNorm(epsilon=c.layer_norm_epsilon, dtype=c.dtype, name=name)
+        x = x + Attention(c, attn_fn=self.attn_fn, name="attn")(ln("ln_1")(x))
+        x = x + MLP(c, name="mlp")(ln("ln_2")(x))
+        return x
+
+
+class GPT2Backbone(nn.Module):
+    """Token+position(+type) embeddings -> n_layer blocks -> final LN."""
+
+    cfg: GPT2Config
+    attn_fn: Callable = staticmethod(dense_causal_attention)
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        c = self.cfg
+        init = nn.initializers.normal(c.initializer_range)
+        wte = self.param("wte", init, (c.vocab_size, c.n_embd), jnp.float32)
+        wpe = self.param("wpe", init, (c.n_positions, c.n_embd), jnp.float32)
+        T = input_ids.shape[-1]
+        h = wte[input_ids] + wpe[jnp.arange(T)]
+        if token_type_ids is not None:
+            # HF GPT-2 embeds token types through the token table.
+            h = h + wte[token_type_ids]
+        h = h.astype(c.dtype)
+        for i in range(c.n_layer):
+            h = Block(c, attn_fn=self.attn_fn, name=f"h_{i}")(h)
+        h = nn.LayerNorm(epsilon=c.layer_norm_epsilon, dtype=c.dtype, name="ln_f")(h)
+        return h, wte
+
+
+class GPT2DoubleHeads(nn.Module):
+    """LM head (tied to wte) + multiple-choice head.
+
+    ``__call__(input_ids [B,N,T], token_type_ids, mc_token_ids [B,N])``
+    returns ``(lm_logits [B,N,T,V], mc_logits [B,N])`` — the same surface the
+    reference's workload consumes (gpt2_train.py ~L60-140).
+    """
+
+    cfg: GPT2Config = field(default_factory=GPT2Config)
+    attn_fn: Callable = staticmethod(dense_causal_attention)
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, mc_token_ids=None):
+        c = self.cfg
+        shape = input_ids.shape  # [..., T]; leading dims flattened for the backbone
+        flat = lambda u: None if u is None else u.reshape(-1, shape[-1])
+        h, wte = GPT2Backbone(c, attn_fn=self.attn_fn, name="transformer")(
+            flat(input_ids), flat(token_type_ids)
+        )
+        lm_logits = (h @ wte.astype(h.dtype).T).astype(jnp.float32)
+        lm_logits = lm_logits.reshape(*shape, c.vocab_size)
+        if mc_token_ids is None:
+            return lm_logits, None
+        # hidden state at each candidate's summary token -> scalar score
+        flat_mc = mc_token_ids.reshape(-1)  # [B*N]
+        picked = h[jnp.arange(flat_mc.shape[0]), flat_mc]  # [B*N, E]
+        init = nn.initializers.normal(c.initializer_range)
+        score = nn.Dense(1, dtype=c.dtype, kernel_init=init, name="mc_head")(picked)
+        mc_logits = score.astype(jnp.float32).reshape(shape[:-1])  # [B, N]
+        return lm_logits, mc_logits
+
+
+def gpt2_small(**kw) -> GPT2Config:
+    return GPT2Config(**kw)
+
+
+def gpt2_tiny_config() -> GPT2Config:
+    """A toy config for tests: same code path, ~0.5M params."""
+    return GPT2Config(vocab_size=512, n_positions=128, n_embd=64, n_layer=2, n_head=4)

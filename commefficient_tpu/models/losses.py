@@ -1,0 +1,83 @@
+"""Loss conventions.
+
+The reference drives every model through a ``compute_loss(model, batch)``
+convention (SURVEY.md §1 L1): cv workloads return (loss, #correct)
+(``fed_worker.py`` eval path ~L290-340), the GPT-2 workload returns
+``lm_coef * CE_lm + mc_coef * CE_mc`` (``gpt2_train.py`` ~L60-140). Here the
+convention is a pure function ``loss_fn(params, batch, rng) -> (loss,
+metrics_dict)`` so it sits directly under ``jax.grad`` inside the jitted
+round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+IGNORE_INDEX = -100  # masked-label sentinel, same convention as the reference
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean CE over positions whose label != IGNORE_INDEX.
+
+    logits [..., V], labels [...] int. Matches
+    ``torch.nn.CrossEntropyLoss(ignore_index=-100)`` semantics used by the
+    GPT-2 LM head in the reference.
+    """
+    mask = (labels != IGNORE_INDEX).astype(jnp.float32)
+    safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def classification_loss(apply_fn):
+    """Build the cv ``loss_fn``: batch = {"x": [B,H,W,C], "y": [B]}.
+
+    Returns (mean CE, {"correct": #correct, "count": B}) — the worker eval
+    path's metrics (fed_worker.py ~L290-340).
+    """
+
+    def loss_fn(params, batch, rng=None):
+        logits = apply_fn(params, batch["x"])
+        loss = softmax_cross_entropy(logits, batch["y"])
+        correct = jnp.sum(jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32)
+        count = jnp.asarray(batch["y"].shape[0], jnp.float32)
+        return loss, {"correct": correct, "count": count}
+
+    return loss_fn
+
+
+def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0):
+    """Build the GPT-2 twin loss (gpt2_train.py ~L60-140).
+
+    batch = {"input_ids": [B,N,T], "token_type_ids": [B,N,T],
+             "lm_labels": [B,N,T] (-100 masked), "mc_token_ids": [B,N],
+             "mc_labels": [B]} with N candidate continuations per dialog.
+    """
+
+    def loss_fn(params, batch, rng=None):
+        lm_logits, mc_logits = apply_fn(
+            params,
+            batch["input_ids"],
+            token_type_ids=batch.get("token_type_ids"),
+            mc_token_ids=batch["mc_token_ids"],
+        )
+        # next-token shift, as in the reference workload
+        lm_loss = softmax_cross_entropy(
+            lm_logits[..., :-1, :], batch["lm_labels"][..., 1:]
+        )
+        mc_loss = softmax_cross_entropy(mc_logits, batch["mc_labels"])
+        loss = lm_coef * lm_loss + mc_coef * mc_loss
+        mc_correct = jnp.sum(
+            jnp.argmax(mc_logits, -1) == batch["mc_labels"]
+        ).astype(jnp.float32)
+        count = jnp.asarray(batch["mc_labels"].shape[0], jnp.float32)
+        return loss, {
+            "lm_loss": lm_loss,
+            "mc_loss": mc_loss,
+            "correct": mc_correct,
+            "count": count,
+        }
+
+    return loss_fn

@@ -1,0 +1,99 @@
+"""ResNet-9, the cifar10-fast 94%-CIFAR-10 workhorse.
+
+Behavioral spec from the reference's ``CommEfficient/models.py`` ~L1-150
+(SURVEY.md §2 "ResNet-9"): prep conv(64) → layer1 conv(128)+pool+residual →
+layer2 conv(256)+pool → layer3 conv(512)+pool+residual → global maxpool →
+linear → logits scaled by 0.125. ~6.5 M parameters.
+
+TPU-first choices (not a translation):
+* **NHWC layout + bfloat16 compute.** Convs run in bf16 on the MXU with
+  float32 params and float32 accumulation (flax default for dot/conv
+  accumulation); logits are returned in float32.
+* **GroupNorm by default instead of BatchNorm.** BN running statistics are
+  per-worker mutable state that does not survive federated averaging — the
+  exact problem the reference works around with Fixup for ImageNet. GroupNorm
+  makes the whole model a pure function of its params, so one flat param
+  vector really is the complete model state (the unit of compression).
+  ``norm="batch"`` is still available for single-worker parity runs; it uses
+  batch statistics only (no running averages), which is equivalent to BN in
+  the reference's high-participation regime where workers see large batches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _make_norm(norm: str, dtype, features: int = 16) -> Callable[..., Any]:
+    if norm == "group":
+        groups = 16 if features % 16 == 0 else features
+        return lambda: nn.GroupNorm(num_groups=groups, dtype=dtype)
+    if norm == "batch":
+        # use_running_average=False always: pure batch statistics, no state.
+        return lambda: nn.BatchNorm(use_running_average=False, dtype=dtype)
+    if norm == "none":
+        return lambda: (lambda x: x)
+    raise ValueError(f"unknown norm {norm!r}")
+
+
+class ConvBlock(nn.Module):
+    """conv → norm → CELU, optionally followed by 2x2 maxpool."""
+
+    features: int
+    norm: str = "group"
+    pool: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, (3, 3), padding=1, use_bias=False, dtype=self.dtype)(x)
+        x = _make_norm(self.norm, self.dtype, self.features)()(x)
+        x = nn.celu(x, alpha=0.3)
+        if self.pool:
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x
+
+
+class Residual(nn.Module):
+    """x + block(block(x)) — the two residual stages of ResNet-9."""
+
+    features: int
+    norm: str = "group"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvBlock(self.features, self.norm, dtype=self.dtype)(x)
+        y = ConvBlock(self.features, self.norm, dtype=self.dtype)(y)
+        return x + y
+
+
+class ResNet9(nn.Module):
+    """9-layer resnet for 32x32 inputs, NHWC.
+
+    Reference: ``ResNet9``/``Net`` + ``conv_bn`` in ``CommEfficient/models.py``
+    ~L1-150.
+    """
+
+    num_classes: int = 10
+    norm: str = "group"
+    width: int = 64
+    logit_scale: float = 0.125
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.width
+        x = x.astype(self.dtype)
+        x = ConvBlock(w, self.norm, dtype=self.dtype)(x)
+        x = ConvBlock(2 * w, self.norm, pool=True, dtype=self.dtype)(x)
+        x = Residual(2 * w, self.norm, dtype=self.dtype)(x)
+        x = ConvBlock(4 * w, self.norm, pool=True, dtype=self.dtype)(x)
+        x = ConvBlock(8 * w, self.norm, pool=True, dtype=self.dtype)(x)
+        x = Residual(8 * w, self.norm, dtype=self.dtype)(x)
+        x = jnp.max(x, axis=(1, 2))  # global max pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32) * self.logit_scale
