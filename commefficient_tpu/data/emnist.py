@@ -1,0 +1,81 @@
+"""FedEMNIST (FEMNIST) — naturally non-IID: each handwriting user is a client.
+
+Behavioral spec from the reference's ``data_utils/fed_emnist.py`` ~L1-150
+(SURVEY.md §2): LEAF-preprocessed FEMNIST, 62 classes (digits + upper +
+lower), 28x28 grayscale, client = LEAF "user". Loads LEAF json shards
+(``all_data_*.json`` with ``users`` / ``user_data``) if present under
+``dataset_dir/femnist``; otherwise generates a synthetic naturally-non-IID
+stand-in where each user has a per-user style shift on class prototypes, so
+the non-IID structure (the thing FEMNIST exists to test) is preserved.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+NUM_CLASSES = 62
+
+
+def _load_leaf(root: str) -> Tuple[dict, list]:
+    xs, ys, client_indices = [], [], []
+    offset = 0
+    for path in sorted(glob.glob(os.path.join(root, "**", "all_data*.json"), recursive=True)):
+        with open(path) as f:
+            blob = json.load(f)
+        for user in blob["users"]:
+            ud = blob["user_data"][user]
+            x = np.asarray(ud["x"], np.float32).reshape(-1, 28, 28, 1)
+            y = np.asarray(ud["y"], np.int32)
+            xs.append(x)
+            ys.append(y)
+            client_indices.append(np.arange(offset, offset + len(y)))
+            offset += len(y)
+    return {"x": np.concatenate(xs), "y": np.concatenate(ys)}, client_indices
+
+
+def _synthetic_femnist(num_clients: int, per_client: int = 120, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    protos = rng.normal(0, 1, size=(NUM_CLASSES, 28, 28, 1)).astype(np.float32)
+    xs, ys, client_indices = [], [], []
+    offset = 0
+    for c in range(num_clients):
+        # each "user" writes a subset of classes in a personal style
+        style = rng.normal(0, 0.5, size=(28, 28, 1)).astype(np.float32)
+        classes = rng.choice(NUM_CLASSES, size=rng.integers(5, 15), replace=False)
+        y = rng.choice(classes, size=per_client).astype(np.int32)
+        x = protos[y] + style + rng.normal(0, 0.3, size=(per_client, 28, 28, 1)).astype(np.float32)
+        xs.append(x.astype(np.float32))
+        ys.append(y)
+        client_indices.append(np.arange(offset, offset + per_client))
+        offset += per_client
+    return {"x": np.concatenate(xs), "y": np.concatenate(ys)}, client_indices
+
+
+def load_fed_emnist(
+    dataset_dir: str, *, num_clients: int, seed: int = 42
+) -> Tuple[FedDataset, FedDataset, bool]:
+    """(train, test, is_real). Test set: 10% of each client's data."""
+    root = os.path.join(dataset_dir, "femnist")
+    real = bool(glob.glob(os.path.join(root, "**", "all_data*.json"), recursive=True))
+    if real:
+        data, client_indices = _load_leaf(root)
+    else:
+        data, client_indices = _synthetic_femnist(num_clients, seed=seed)
+    train_ix, test_ix = [], []
+    for ix in client_indices:
+        cut = max(1, int(0.9 * len(ix)))
+        train_ix.append(ix[:cut])
+        test_ix.append(ix[cut:])
+    train = FedDataset(data, len(client_indices), client_indices=train_ix, seed=seed)
+    test_all = np.concatenate(test_ix)
+    test = FedDataset(
+        {k: v[test_all] for k, v in data.items()}, 1, iid=True, seed=seed
+    )
+    return train, test, real

@@ -1,0 +1,124 @@
+"""FedDataset — map a classic dataset onto virtual clients.
+
+Behavioral spec from the reference's ``data_utils/fed_dataset.py`` ~L20-140
+(SURVEY.md §2 "FedDataset base"): N examples are partitioned across
+``num_clients`` shards either IID (global shuffle, even split) or
+pathologically non-IID (sort by label, deal contiguous label shards so each
+client sees few classes); the client->index map is deterministic from the
+seed; items are tagged with their client id.
+
+TPU-first shape: this layer is pure host-side numpy (it runs outside jit, as
+the reference's Dataset runs outside CUDA). Batches leave here as stacked
+``[num_workers, batch, ...]`` arrays ready for ``jax.device_put`` onto the
+``workers`` mesh axis — replacing the reference's per-worker mp.Queue batch
+routing (fed_aggregator.py ~L150-260).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class FedDataset:
+    """In-memory dataset partitioned over virtual clients.
+
+    Args:
+      data: dict of equally-long numpy arrays (e.g. {"x": [N,...], "y": [N]}).
+      num_clients: number of virtual clients to shard over.
+      iid: IID split vs pathological non-IID by label.
+      labels_key: which entry of ``data`` holds labels (for non-IID sorting).
+      seed: controls the assignment; equal seeds => equal shards everywhere.
+      shards_per_client: non-IID only — how many contiguous label shards each
+        client receives (2 in the reference's pathological split).
+      client_indices: optional explicit client->indices map for *naturally*
+        federated datasets (FEMNIST: one handwriting user per client,
+        PersonaChat: one persona per client), overriding the synthetic split.
+    """
+
+    def __init__(
+        self,
+        data: Dict[str, np.ndarray],
+        num_clients: int,
+        *,
+        iid: bool = True,
+        labels_key: str = "y",
+        seed: int = 42,
+        shards_per_client: int = 2,
+        client_indices: Optional[List[np.ndarray]] = None,
+    ):
+        lengths = {k: len(v) for k, v in data.items()}
+        if len(set(lengths.values())) != 1:
+            raise ValueError(f"ragged data arrays: {lengths}")
+        self.data = data
+        self.n = next(iter(lengths.values()))
+        self.num_clients = num_clients
+        self.seed = seed
+        if client_indices is not None:
+            self.client_indices = [np.asarray(ix, np.int64) for ix in client_indices]
+            self.num_clients = len(self.client_indices)
+        elif iid:
+            self.client_indices = self._iid_split()
+        else:
+            self.client_indices = self._non_iid_split(labels_key, shards_per_client)
+
+    def _iid_split(self) -> List[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(self.n)
+        return [np.sort(s) for s in np.array_split(perm, self.num_clients)]
+
+    def _non_iid_split(self, labels_key: str, shards_per_client: int) -> List[np.ndarray]:
+        """Pathological split: sort by label, deal contiguous shards.
+
+        Mirrors the reference's ``_make_client_assignments``
+        (fed_dataset.py ~L20-100): with S = num_clients * shards_per_client
+        shards, each client gets ``shards_per_client`` random shards, so most
+        clients see only a couple of distinct labels.
+        """
+        rng = np.random.default_rng(self.seed)
+        labels = np.asarray(self.data[labels_key])
+        order = np.argsort(labels, kind="stable")
+        n_shards = self.num_clients * shards_per_client
+        shards = np.array_split(order, n_shards)
+        shard_perm = rng.permutation(n_shards)
+        out = []
+        for c in range(self.num_clients):
+            take = shard_perm[c * shards_per_client : (c + 1) * shards_per_client]
+            out.append(np.sort(np.concatenate([shards[s] for s in take])))
+        return out
+
+    # -- stats ------------------------------------------------------------
+    @property
+    def images_per_client(self) -> np.ndarray:
+        """Per-client example counts (reference bookkeeping, ~L100-140)."""
+        return np.asarray([len(ix) for ix in self.client_indices])
+
+    def __len__(self) -> int:
+        return self.n
+
+    # -- batch access -----------------------------------------------------
+    def client_batch(
+        self, client_id: int, batch_size: int, rng: np.random.Generator
+    ) -> Dict[str, np.ndarray]:
+        """Sample a batch from one client's shard (with replacement iff the
+        shard is smaller than the batch, as the reference's per-client
+        DataLoader effectively does for tiny clients)."""
+        ix = self.client_indices[client_id]
+        replace = len(ix) < batch_size
+        chosen = rng.choice(ix, size=batch_size, replace=replace)
+        return {k: v[chosen] for k, v in self.data.items()}
+
+    def eval_batches(self, batch_size: int):
+        """Sequential batches over the whole dataset (the val path,
+        fed_worker.py ~L290-340). Final partial batch is dropped-padded by
+        repeating the last row so shapes stay static under jit; a "count"
+        mask is included for correct metric averaging."""
+        for start in range(0, self.n, batch_size):
+            ix = np.arange(start, min(start + batch_size, self.n))
+            valid = len(ix)
+            if valid < batch_size:
+                ix = np.concatenate([ix, np.full(batch_size - valid, ix[-1])])
+            batch = {k: v[ix] for k, v in self.data.items()}
+            batch["_valid"] = np.asarray(valid, np.int32)
+            yield batch

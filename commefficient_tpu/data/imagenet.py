@@ -1,0 +1,53 @@
+"""FedImageNet — ImageNet for the FixupResNet runs, sharded over clients.
+
+Behavioral spec from the reference's ``data_utils/fed_imagenet.py`` ~L1-120
+(SURVEY.md §2): ImageFolder-style layout (``train/<wnid>/*.JPEG``), client
+sharding over classes. Real JPEG decoding would need PIL + the actual
+dataset; with zero egress we support (a) a preprocessed ``.npy`` cache
+(``imagenet_x.npy``/``imagenet_y.npy`` under ``dataset_dir/imagenet``) and
+(b) a synthetic stand-in at reduced resolution for pipeline/benchmark runs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+
+def _synthetic_imagenet(
+    num_classes: int = 1000, n: int = 20_000, size: int = 64, seed: int = 9
+):
+    rng = np.random.default_rng(seed)
+    protos = rng.uniform(-1, 1, size=(num_classes, size, size, 3)).astype(np.float32)
+    y = rng.integers(0, num_classes, size=n).astype(np.int32)
+    x = protos[y] + rng.normal(0, 0.5, size=(n, size, size, 3)).astype(np.float32)
+    return {"x": x.astype(np.float32), "y": y}
+
+
+def load_fed_imagenet(
+    dataset_dir: str,
+    *,
+    num_clients: int,
+    iid: bool = False,
+    seed: int = 42,
+    num_classes: int = 1000,
+    synthetic_size: int = 64,
+) -> Tuple[FedDataset, FedDataset, bool]:
+    root = os.path.join(dataset_dir, "imagenet")
+    xp, yp = os.path.join(root, "imagenet_x.npy"), os.path.join(root, "imagenet_y.npy")
+    real = os.path.exists(xp) and os.path.exists(yp)
+    if real:
+        data = {"x": np.load(xp), "y": np.load(yp)}
+    else:
+        data = _synthetic_imagenet(num_classes, size=synthetic_size, seed=seed)
+    n = len(data["y"])
+    cut = int(0.95 * n)
+    train = FedDataset(
+        {k: v[:cut] for k, v in data.items()}, num_clients, iid=iid, seed=seed
+    )
+    test = FedDataset({k: v[cut:] for k, v in data.items()}, 1, iid=True, seed=seed)
+    return train, test, real

@@ -1,0 +1,185 @@
+"""FedPersona — PersonaChat for the GPT-2 workload; each dialog = one client.
+
+Behavioral spec from the reference's ``data_utils/fed_personachat.py`` +
+helpers in ``gpt2_train.py`` ~L60-140 (SURVEY.md §2 "FedPersona"): the
+PersonaChat json is tokenized and assembled by ``build_input_from_segments``
+with special tokens ``<bos> <eos> <speaker1> <speaker2> <pad>``; each
+example is a dialog context plus ``num_candidates`` candidate replies (the
+last one true, the rest distractors); LM labels cover only the true reply;
+the MC head picks the true candidate. Each persona/dialog is one client.
+
+This module reproduces that *assembly contract* exactly. Token source is
+either the real ``personachat_self_original.json`` (tokenized with the HF
+GPT-2 tokenizer if its vocab files are on disk) or a synthetic corpus of
+persona-conditioned integer sequences — same shapes, same special-token
+scheme, no network.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+# appended at the end of the base vocabulary, reference order
+SPECIAL_TOKENS = ("<bos>", "<eos>", "<speaker1>", "<speaker2>", "<pad>")
+
+
+def special_ids(base_vocab: int) -> Dict[str, int]:
+    return {name: base_vocab + i for i, name in enumerate(SPECIAL_TOKENS)}
+
+
+def vocab_with_specials(base_vocab: int) -> int:
+    return base_vocab + len(SPECIAL_TOKENS)
+
+
+def build_input_from_segments(
+    persona: List[List[int]],
+    history: List[List[int]],
+    reply: List[int],
+    sp: Dict[str, int],
+    *,
+    lm_labels: bool,
+    max_len: int,
+) -> Dict[str, np.ndarray]:
+    """Assemble one candidate sequence (gpt2_train.py ~L60-100 semantics).
+
+    Layout: <bos> persona... then alternating <speaker2>/<speaker1> history
+    turns, then <speaker2> reply <eos>. token_type marks each position with
+    its speaker token. lm_labels = -100 everywhere except the true reply.
+    """
+    seq = [sp["<bos>"]] + [t for p in persona for t in p]
+    types = [sp["<speaker2>"]] * len(seq)
+    for i, turn in enumerate(history):
+        spk = sp["<speaker1>"] if (len(history) - i) % 2 == 1 else sp["<speaker2>"]
+        seq += [spk] + turn
+        types += [spk] * (len(turn) + 1)
+    reply_seq = [sp["<speaker2>"]] + reply + [sp["<eos>"]]
+    seq += reply_seq
+    types += [sp["<speaker2>"]] * len(reply_seq)
+    labels = [-100] * (len(seq) - len(reply_seq)) + (
+        [-100] + reply + [sp["<eos>"]] if lm_labels else [-100] * len(reply_seq)
+    )
+    # left-truncate history, keep the reply; pad right to max_len
+    seq, types, labels = seq[-max_len:], types[-max_len:], labels[-max_len:]
+    mc_token = len(seq) - 1  # index of the last real token
+    pad = max_len - len(seq)
+    out = {
+        "input_ids": np.asarray(seq + [sp["<pad>"]] * pad, np.int32),
+        "token_type_ids": np.asarray(types + [sp["<pad>"]] * pad, np.int32),
+        "lm_labels": np.asarray(labels + [-100] * pad, np.int32),
+        "mc_token_ids": np.asarray(mc_token, np.int32),
+    }
+    return out
+
+
+def _synthetic_dialogs(
+    num_clients: int,
+    *,
+    base_vocab: int,
+    dialogs_per_client: int = 8,
+    turn_len: int = 12,
+    seed: int = 11,
+):
+    """Persona-conditioned integer dialogs: each client's turns are drawn from
+    a client-specific token band, so the true candidate is statistically
+    distinguishable from distractors sampled from other clients."""
+    rng = np.random.default_rng(seed)
+    clients = []
+    for c in range(num_clients):
+        lo = rng.integers(0, max(1, base_vocab - 200))
+        band = (int(lo), int(lo) + 200)
+        persona = [list(rng.integers(*band, size=turn_len)) for _ in range(3)]
+        dialogs = []
+        for _ in range(dialogs_per_client):
+            history = [list(rng.integers(*band, size=turn_len)) for _ in range(3)]
+            reply = list(rng.integers(*band, size=turn_len))
+            dialogs.append((persona, history, reply))
+        clients.append(dialogs)
+    return clients
+
+
+def _load_real_dialogs(path: str, max_history: int):
+    """personachat_self_original.json -> per-client (persona, history, reply)
+    token lists. Requires a local GPT-2 tokenizer (transformers, offline)."""
+    from transformers import GPT2Tokenizer  # vocab must already be on disk
+
+    tok = GPT2Tokenizer.from_pretrained("gpt2")
+    enc = lambda s: tok.encode(s)
+    with open(path) as f:
+        raw = json.load(f)["train"]
+    clients = []
+    for dialog in raw:
+        persona = [enc(p) for p in dialog["personality"]]
+        dialogs = []
+        for utt in dialog["utterances"]:
+            history = [enc(h) for h in utt["history"][-(2 * max_history + 1):]]
+            reply = enc(utt["candidates"][-1])
+            dialogs.append((persona, history, reply))
+        clients.append(dialogs)
+    return clients
+
+
+def load_fed_personachat(
+    dataset_dir: str,
+    *,
+    num_clients: int = 64,
+    num_candidates: int = 2,
+    max_history: int = 2,
+    max_seq_len: int = 128,
+    base_vocab: int = 512,
+    seed: int = 42,
+) -> Tuple[FedDataset, FedDataset, bool, int]:
+    """Returns (train, test, is_real, vocab_size_with_specials).
+
+    Each example: ``input_ids/token_type_ids/lm_labels [N, T]``,
+    ``mc_token_ids [N]``, ``mc_labels`` scalar (always the last candidate,
+    as in the reference). Distractors are replies from *other* clients.
+    """
+    path = os.path.join(dataset_dir, "personachat_self_original.json")
+    real = os.path.exists(path)
+    if real:
+        clients = _load_real_dialogs(path, max_history)[:num_clients]
+        base_vocab = 50257
+    else:
+        clients = _synthetic_dialogs(num_clients, base_vocab=base_vocab, seed=seed)
+    sp = special_ids(base_vocab)
+    rng = np.random.default_rng(seed)
+
+    rows = {k: [] for k in ("input_ids", "token_type_ids", "lm_labels", "mc_token_ids", "mc_labels")}
+    client_indices: List[np.ndarray] = []
+    all_replies = [d[2] for cl in clients for d in cl]
+    row = 0
+    for ci, dialogs in enumerate(clients):
+        start = row
+        for persona, history, reply in dialogs:
+            cands = [all_replies[rng.integers(len(all_replies))] for _ in range(num_candidates - 1)]
+            cands.append(reply)  # true candidate last, reference convention
+            per_cand = [
+                build_input_from_segments(
+                    persona, history, c, sp,
+                    lm_labels=(j == num_candidates - 1), max_len=max_seq_len,
+                )
+                for j, c in enumerate(cands)
+            ]
+            for k in ("input_ids", "token_type_ids", "lm_labels", "mc_token_ids"):
+                rows[k].append(np.stack([pc[k] for pc in per_cand]))
+            rows["mc_labels"].append(np.asarray(num_candidates - 1, np.int32))
+            row += 1
+        client_indices.append(np.arange(start, row))
+    data = {k: np.stack(v) for k, v in rows.items()}
+
+    # 90/10 per-client split for validation
+    train_ix, test_ix = [], []
+    for ix in client_indices:
+        cut = max(1, int(0.9 * len(ix)))
+        train_ix.append(ix[:cut])
+        test_ix.append(ix[cut:])
+    train = FedDataset(data, len(clients), client_indices=train_ix, seed=seed)
+    test_all = np.concatenate(test_ix)
+    test = FedDataset({k: v[test_all] for k, v in data.items()}, 1, iid=True, seed=seed)
+    return train, test, real, vocab_with_specials(base_vocab)

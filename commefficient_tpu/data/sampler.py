@@ -1,0 +1,73 @@
+"""FedSampler — per-round client participation + batch assembly.
+
+Behavioral spec from the reference's ``data_utils/fed_sampler.py`` ~L1-80
+(SURVEY.md §2 "FedSampler"): each round, sample ``num_workers`` distinct
+clients uniformly from ``num_clients`` (the participation fraction), and
+group each participant's ``local_batch_size`` examples so every worker gets
+its clients' shards.
+
+Here a round's output is ONE device-ready structure instead of per-process
+queue messages: ``client_ids [W]`` plus a batch dict of ``[W, B, ...]``
+arrays, which the round engine shards over the ``workers`` mesh axis.
+Deterministic from (seed, round) so runs are reproducible and resumable
+without serializing generator state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.data.fed_dataset import FedDataset
+
+Batch = Dict[str, np.ndarray]
+Augment = Callable[[Batch, np.random.Generator], Batch]
+
+
+class FedSampler:
+    def __init__(
+        self,
+        dataset: FedDataset,
+        *,
+        num_workers: int,
+        local_batch_size: int,
+        seed: int = 42,
+        augment: Optional[Augment] = None,
+    ):
+        if dataset.num_clients < num_workers:
+            raise ValueError("need num_clients >= num_workers")
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.local_batch_size = local_batch_size
+        self.seed = seed
+        self.augment = augment
+
+    def steps_per_epoch(self) -> int:
+        """Rounds per epoch such that one epoch visits ~the whole dataset,
+        matching the reference's effective epoch = N / (workers * B)."""
+        per_round = self.num_workers * self.local_batch_size
+        return max(1, len(self.dataset) // per_round)
+
+    def sample_round(self, round_idx: int) -> Tuple[np.ndarray, Batch]:
+        """(client_ids [W] int32, batch {k: [W, B, ...]}) for one round."""
+        rng = np.random.default_rng((self.seed, round_idx))
+        clients = rng.choice(
+            self.dataset.num_clients, size=self.num_workers, replace=False
+        )
+        shards = []
+        for c in clients:
+            b = self.dataset.client_batch(int(c), self.local_batch_size, rng)
+            if self.augment is not None:
+                b = self.augment(b, rng)
+            shards.append(b)
+        batch = {
+            k: np.stack([s[k] for s in shards]) for k in shards[0]
+        }
+        return clients.astype(np.int32), batch
+
+    def epoch(self, epoch_idx: int):
+        steps = self.steps_per_epoch()
+        base = epoch_idx * steps
+        for s in range(steps):
+            yield self.sample_round(base + s)

@@ -1,0 +1,114 @@
+"""Data-layer tests: sharding semantics, determinism, batch shapes."""
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import (
+    FedDataset,
+    FedSampler,
+    load_fed_cifar10,
+    load_fed_emnist,
+    load_fed_personachat,
+    augment_batch,
+)
+
+
+def _toy(n=1000, num_classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": rng.normal(size=(n, 8)).astype(np.float32),
+        "y": rng.integers(0, num_classes, size=n).astype(np.int32),
+    }
+
+
+def test_iid_split_partitions_everything():
+    ds = FedDataset(_toy(), num_clients=7, iid=True, seed=1)
+    allix = np.concatenate(ds.client_indices)
+    assert len(allix) == 1000
+    assert len(np.unique(allix)) == 1000
+    assert ds.images_per_client.min() >= 1000 // 7
+
+
+def test_non_iid_split_concentrates_labels():
+    data = _toy(n=2000)
+    iid = FedDataset(data, num_clients=20, iid=True, seed=1)
+    non = FedDataset(data, num_clients=20, iid=False, seed=1)
+    # labels seen per client: non-IID clients see far fewer distinct labels
+    nuniq = lambda ds: np.mean([len(np.unique(data["y"][ix])) for ix in ds.client_indices])
+    assert nuniq(non) <= 4 < nuniq(iid)
+    allix = np.concatenate(non.client_indices)
+    assert len(np.unique(allix)) == 2000  # still a partition
+
+
+def test_split_deterministic_across_instances():
+    a = FedDataset(_toy(), num_clients=5, iid=False, seed=9)
+    b = FedDataset(_toy(), num_clients=5, iid=False, seed=9)
+    for ia, ib in zip(a.client_indices, b.client_indices):
+        np.testing.assert_array_equal(ia, ib)
+
+
+def test_sampler_round_shapes_and_determinism():
+    ds = FedDataset(_toy(), num_clients=16, seed=3)
+    s = FedSampler(ds, num_workers=4, local_batch_size=8, seed=3)
+    ids1, batch1 = s.sample_round(5)
+    ids2, batch2 = s.sample_round(5)
+    np.testing.assert_array_equal(ids1, ids2)
+    np.testing.assert_array_equal(batch1["x"], batch2["x"])
+    assert ids1.shape == (4,)
+    assert len(np.unique(ids1)) == 4  # distinct participants
+    assert batch1["x"].shape == (4, 8, 8)
+    assert batch1["y"].shape == (4, 8)
+
+
+def test_sampler_batches_come_from_the_right_client():
+    data = _toy()
+    ds = FedDataset(data, num_clients=10, iid=False, seed=0)
+    s = FedSampler(ds, num_workers=3, local_batch_size=4, seed=0)
+    ids, batch = s.sample_round(0)
+    for w, cid in enumerate(ids):
+        client_rows = data["x"][ds.client_indices[cid]]
+        for b in range(4):
+            assert (batch["x"][w, b] == client_rows).all(axis=1).any()
+
+
+def test_cifar10_synthetic_fallback_pipeline(tmp_path):
+    tr, te, real = load_fed_cifar10(str(tmp_path), num_clients=8, iid=False)
+    assert not real
+    assert tr.data["x"].shape[1:] == (32, 32, 3)
+    assert tr.data["x"].dtype == np.float32
+    s = FedSampler(tr, num_workers=4, local_batch_size=2, augment=augment_batch, seed=0)
+    _, batch = s.sample_round(0)
+    assert batch["x"].shape == (4, 2, 32, 32, 3)
+
+
+def test_femnist_natural_clients(tmp_path):
+    tr, te, real = load_fed_emnist(str(tmp_path), num_clients=12)
+    assert not real
+    assert tr.num_clients == 12
+    assert tr.data["x"].shape[1:] == (28, 28, 1)
+    # naturally non-IID: each client sees a small subset of the 62 classes
+    for ix in tr.client_indices:
+        assert len(np.unique(tr.data["y"][ix])) <= 15
+
+
+def test_personachat_assembly_contract(tmp_path):
+    tr, te, real, vocab = load_fed_personachat(
+        str(tmp_path), num_clients=6, num_candidates=2, max_seq_len=64
+    )
+    assert not real
+    d = tr.data
+    N, C, T = d["input_ids"].shape
+    assert C == 2 and T == 64
+    assert d["lm_labels"].shape == (N, C, T)
+    assert d["mc_token_ids"].shape == (N, C)
+    # only the true (last) candidate carries LM labels
+    assert (d["lm_labels"][:, :-1] == -100).all()
+    assert (d["lm_labels"][:, -1] != -100).any(axis=-1).all()
+    # mc_token points at a real (non-pad) position
+    pad = vocab - 1
+    for i in range(min(N, 10)):
+        for c in range(C):
+            t = d["mc_token_ids"][i, c]
+            assert d["input_ids"][i, c, t] != pad
+    # all ids within vocab
+    assert d["input_ids"].max() < vocab
