@@ -41,8 +41,11 @@ def classification_loss(apply_fn):
     def loss_fn(params, batch, rng=None):
         logits = apply_fn(params, batch["x"])
         loss = softmax_cross_entropy(logits, batch["y"])
-        correct = jnp.sum(jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32)
-        count = jnp.asarray(batch["y"].shape[0], jnp.float32)
+        mask = batch["y"] != IGNORE_INDEX  # padded eval rows carry -100
+        correct = jnp.sum(
+            (jnp.argmax(logits, -1) == batch["y"]) & mask
+        ).astype(jnp.float32)
+        count = jnp.sum(mask).astype(jnp.float32)
         return loss, {"correct": correct, "count": count}
 
     return loss_fn
@@ -69,10 +72,11 @@ def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0)
         )
         mc_loss = softmax_cross_entropy(mc_logits, batch["mc_labels"])
         loss = lm_coef * lm_loss + mc_coef * mc_loss
+        mc_mask = batch["mc_labels"] != IGNORE_INDEX  # padded eval rows
         mc_correct = jnp.sum(
-            jnp.argmax(mc_logits, -1) == batch["mc_labels"]
+            (jnp.argmax(mc_logits, -1) == batch["mc_labels"]) & mc_mask
         ).astype(jnp.float32)
-        count = jnp.asarray(batch["mc_labels"].shape[0], jnp.float32)
+        count = jnp.sum(mc_mask).astype(jnp.float32)
         return loss, {
             "lm_loss": lm_loss,
             "mc_loss": mc_loss,

@@ -1,0 +1,34 @@
+"""Parallel layer (L2+L3): mesh, the fused federated round, session API."""
+
+from commefficient_tpu.parallel.mesh import make_mesh, WORKERS, MODEL, SEQ
+from commefficient_tpu.parallel.round import (
+    FedState,
+    init_state,
+    build_round_fn,
+    build_eval_fn,
+    mask_classification,
+    mask_gpt2,
+)
+from commefficient_tpu.parallel.api import (
+    FederatedSession,
+    FedModel,
+    FedOptimizer,
+    make_fed_pair,
+)
+
+__all__ = [
+    "make_mesh",
+    "WORKERS",
+    "MODEL",
+    "SEQ",
+    "FedState",
+    "init_state",
+    "build_round_fn",
+    "build_eval_fn",
+    "mask_classification",
+    "mask_gpt2",
+    "FederatedSession",
+    "FedModel",
+    "FedOptimizer",
+    "make_fed_pair",
+]

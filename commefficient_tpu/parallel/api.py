@@ -1,0 +1,166 @@
+"""FedModel / FedOptimizer — the reference-shaped public API.
+
+The reference exposes two objects (SURVEY.md §2): ``FedModel`` (callable
+like a module; owns workers + shared state) and ``FedOptimizer``
+(``.step()`` applies the server update). Here both are thin views over one
+``FederatedSession``, because on TPU the whole round is a single fused XLA
+program (SURVEY.md §7) — splitting compute-grads from apply-update into two
+device programs would only add an HBM round-trip. The call *sequence* is
+preserved:
+
+    metrics = fed_model(client_ids, batch)   # runs the fused round at
+    fed_opt.step()                           # the current LR; step() advances
+                                             # the schedule clock
+
+Deviation from the reference, by design: ``__call__`` already applies the
+update (there is no observable intermediate state between the two calls in
+the reference's API contract either — workers and server state are opaque).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.ops.countsketch import CountSketch
+from commefficient_tpu.ops.param_utils import ravel_params
+from commefficient_tpu.parallel.mesh import make_mesh, worker_sharding, replicated
+from commefficient_tpu.parallel.round import (
+    FedState,
+    build_eval_fn,
+    build_round_fn,
+    init_state,
+    mask_classification,
+)
+from commefficient_tpu.utils.config import Config
+
+
+class FederatedSession:
+    """Owns the mesh, the jitted round, and the FedState."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any,
+        loss_fn: Callable,
+        *,
+        mesh=None,
+        eval_loss_fn: Optional[Callable] = None,
+        mask_batch: Callable = mask_classification,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.num_devices)
+        vec, unravel = ravel_params(params)
+        self.unravel = unravel
+        self.grad_size = int(vec.size)  # args.grad_size analog
+        self.spec = None
+        if cfg.mode == "sketch":
+            self.spec = CountSketch(
+                d=self.grad_size,
+                c=cfg.num_cols,
+                r=cfg.num_rows,
+                num_blocks=cfg.num_blocks,
+                seed=cfg.seed,
+            )
+        self.state = init_state(cfg, vec, self.spec)
+        self.round_fn = build_round_fn(cfg, loss_fn, unravel, self.mesh, self.spec)
+        self.eval_fn = build_eval_fn(eval_loss_fn or loss_fn, unravel, mask_batch)
+        self._batch_sharding = worker_sharding(self.mesh)
+        self._replicated = replicated(self.mesh)
+
+    # -- train ------------------------------------------------------------
+    def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray], lr: float):
+        ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
+        dev_batch = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding), batch
+        )
+        self.state, metrics = self.round_fn(
+            self.state, ids, dev_batch, jnp.float32(lr)
+        )
+        return metrics
+
+    # -- eval -------------------------------------------------------------
+    def evaluate(self, batches: Iterable[Dict[str, np.ndarray]]) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        n = 0.0
+        n_batches = 0
+        for b in batches:
+            out = self.eval_fn(self.state.params_vec, jax.tree.map(jnp.asarray, b))
+            for k, v in out.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += float(b["_valid"])
+            n_batches += 1
+        result = {"loss": totals.get("loss_sum", 0.0) / max(n, 1.0)}
+        if "count" in totals and totals["count"] > 0:
+            result["accuracy"] = totals.get("correct", 0.0) / totals["count"]
+        for k, v in totals.items():
+            # loss_sum/correct/count are per-row sums normalized above; any
+            # other aux key is a per-batch mean, so average over batches.
+            if k not in ("loss_sum", "correct", "count"):
+                result[k] = v / max(n_batches, 1)
+        return result
+
+    # -- weights ----------------------------------------------------------
+    @property
+    def params(self):
+        return self.unravel(self.state.params_vec)
+
+    def bytes_per_round(self) -> Dict[str, int]:
+        """Upload/download bytes per participating client (BASELINE.md
+        accounting) — the headline communication metric."""
+        d, k = self.grad_size, self.cfg.k
+        up = {
+            "uncompressed": d,
+            "fedavg": d,
+            "true_topk": d,
+            "local_topk": 2 * k,
+            "sketch": self.cfg.num_rows * self.cfg.num_cols,
+        }[self.cfg.mode]
+        down = k if self.cfg.do_topk_down else d
+        return {"upload_floats": up, "download_floats": down,
+                "upload_bytes": 4 * up, "download_bytes": 4 * down}
+
+
+class FedModel:
+    """Callable façade (the ``FedCommEffModel`` analog)."""
+
+    def __init__(self, session: FederatedSession):
+        self.session = session
+
+    def __call__(self, client_ids, batch, lr: float):
+        return self.session.train_round(client_ids, batch, lr)
+
+    def evaluate(self, batches):
+        return self.session.evaluate(batches)
+
+    @property
+    def params(self):
+        return self.session.params
+
+
+class FedOptimizer:
+    """Schedule clock (the ``FedCommEffOptimizer`` analog). The server update
+    itself is fused into the round program; ``step()`` advances the LR."""
+
+    def __init__(self, session: FederatedSession, lr_fn: Callable[[int], float]):
+        self.session = session
+        self.lr_fn = lr_fn
+        self._step = 0
+
+    def get_lr(self) -> float:
+        return float(self.lr_fn(self._step))
+
+    def step(self) -> None:
+        self._step += 1
+
+    def zero_grad(self) -> None:  # API parity; nothing to zero functionally
+        pass
+
+
+def make_fed_pair(cfg: Config, params, loss_fn, lr_fn, **kw):
+    """Reference-style constructor: (FedModel, FedOptimizer) sharing a session."""
+    session = FederatedSession(cfg, params, loss_fn, **kw)
+    return FedModel(session), FedOptimizer(session, lr_fn)

@@ -1,0 +1,53 @@
+"""Device-mesh helpers.
+
+The reference's "cluster" is one host: N OS processes pinned to GPUs talking
+through POSIX shared memory (SURVEY.md §2 "IPC backend"). The TPU-native
+equivalent is a ``jax.sharding.Mesh``: the ``workers`` axis replaces worker
+processes (gradient/sketch aggregation becomes ``lax.psum`` over ICI), and
+two extra axes — ``model`` (tensor parallel) and ``seq`` (sequence parallel
+for ring attention) — are capabilities the reference never had but fall out
+naturally from the mesh formulation. Multi-host: build the same mesh over
+``jax.devices()`` after ``jax.distributed.initialize()``; psum then spans
+ICI within a slice and DCN across slices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKERS = "workers"
+MODEL = "model"
+SEQ = "seq"
+
+
+def make_mesh(
+    num_workers_axis: int = 1,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (workers, model, seq) mesh over the available devices.
+
+    ``num_workers_axis * model * seq`` must equal the device count used.
+    With one device this still yields a valid 1x1x1 mesh, so every code path
+    is mesh-shaped even single-chip (jit specializes the collectives away).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_workers_axis * model * seq
+    if len(devices) < need:
+        raise ValueError(f"need {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(num_workers_axis, model, seq)
+    return Mesh(arr, (WORKERS, MODEL, SEQ))
+
+
+def worker_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the workers axis (for [W, ...] batches)."""
+    return NamedSharding(mesh, P(WORKERS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,322 @@
+"""The federated round engine — one jitted XLA program per round.
+
+This is the TPU-native re-design of the reference's entire L2+L3 runtime
+(``fed_aggregator.py`` FedModel/FedOptimizer ~L30-560 + ``fed_worker.py``
+worker_loop ~L20-420 + the shared-memory IPC backend, SURVEY.md §3.1): where
+the reference runs a parameter-server process and per-GPU worker processes
+exchanging tensors through POSIX shm and mp.Queues, here the WHOLE round —
+per-client gradients, local momentum/error feedback, compression, cross-
+worker aggregation, and the server update — is ONE jitted function over a
+``Mesh``:
+
+  * worker processes      -> shards of a ``shard_map`` over the ``workers`` axis
+  * shm gradient gather   -> ``lax.psum`` over ICI (exact for sketches: linearity)
+  * ``ps_weights`` in shm -> replicated ``[D]`` param vector in HBM
+  * per-client state rows -> ``[num_clients, D]`` arrays, gathered/scattered
+                             for the round's participants at the jit top level
+  * server momentum/error -> dense ``[D]`` vectors or ``[r, c]`` sketch tables
+                             carried in ``FedState``
+
+Mode semantics follow the reference exactly (server helpers,
+fed_aggregator.py ~L380-540): updates are accumulated UNSCALED in
+momentum/error state; the learning rate multiplies only the applied update.
+
+Supported (mode, error_type) pairs mirror the reference's use:
+  uncompressed/fedavg: error none;   true_topk/sketch: virtual or none;
+  local_topk: local or none.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from commefficient_tpu.models.losses import IGNORE_INDEX
+from commefficient_tpu.ops.countsketch import (
+    CountSketch,
+    estimate_all,
+    sketch_vec,
+    unsketch,
+)
+from commefficient_tpu.ops.param_utils import clip_by_global_norm
+from commefficient_tpu.ops.topk import topk_dense
+from commefficient_tpu.parallel.mesh import WORKERS
+from commefficient_tpu.utils.config import Config
+
+
+class FedState(NamedTuple):
+    """All mutable server + client state. Absent pieces are empty tuples so
+    the pytree structure is static under jit."""
+
+    params_vec: jnp.ndarray  # [D] — the ps_weights analog
+    momentum: Any = ()  # [D] dense | [r, c] sketch table | ()
+    error: Any = ()  # [D] dense | [r, c] sketch table | ()
+    client_vel: Any = ()  # [num_clients, D] | ()
+    client_err: Any = ()  # [num_clients, D] | ()
+    step: jnp.ndarray = None  # scalar int32
+
+
+def init_state(cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch]) -> FedState:
+    """Allocate exactly the state the (mode, error_type, momenta) combination
+    needs — the analog of FedModel.__init__'s conditional shm allocation
+    (fed_aggregator.py ~L60-130)."""
+    d = params_vec.shape[0]
+    f32 = jnp.float32
+    momentum: Any = ()
+    error: Any = ()
+    if cfg.mode == "sketch":
+        if cfg.virtual_momentum > 0:
+            momentum = jnp.zeros(spec.table_shape, f32)
+        if cfg.error_type == "virtual":
+            error = jnp.zeros(spec.table_shape, f32)
+    else:  # dense modes: uncompressed / fedavg / true_topk / local_topk
+        if cfg.virtual_momentum > 0 or cfg.mode == "true_topk":
+            momentum = jnp.zeros((d,), f32)
+        if cfg.mode == "true_topk" and cfg.error_type == "virtual":
+            error = jnp.zeros((d,), f32)
+    client_vel: Any = ()
+    client_err: Any = ()
+    if cfg.local_momentum > 0:
+        client_vel = jnp.zeros((cfg.num_clients, d), f32)
+    if cfg.error_type == "local":
+        client_err = jnp.zeros((cfg.num_clients, d), f32)
+    return FedState(
+        params_vec=params_vec.astype(f32),
+        momentum=momentum,
+        error=error,
+        client_vel=client_vel,
+        client_err=client_err,
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _validate(cfg: Config) -> None:
+    ok = {
+        "uncompressed": ("none",),
+        "fedavg": ("none",),
+        "true_topk": ("none", "virtual"),
+        "sketch": ("none", "virtual"),
+        "local_topk": ("none", "local"),
+    }
+    if cfg.error_type not in ok[cfg.mode]:
+        raise NotImplementedError(
+            f"(mode={cfg.mode}, error_type={cfg.error_type}) is not a "
+            f"reference-supported combination; allowed: {ok[cfg.mode]}"
+        )
+
+
+def build_round_fn(
+    cfg: Config,
+    loss_fn: Callable,
+    unravel: Callable,
+    mesh,
+    spec: Optional[CountSketch] = None,
+):
+    """Compile the per-round step.
+
+    Args:
+      loss_fn: ``(params_pytree, batch) -> (loss, aux_metrics)``.
+      unravel: flat [D] vector -> params pytree (from ``ravel_params``).
+      mesh: a Mesh with a ``workers`` axis of size cfg.num_devices.
+      spec: CountSketch spec (sketch mode only).
+    Returns:
+      ``round_fn(state, client_ids [W], batch {k: [W, ...]}, lr) ->
+      (new_state, metrics)`` — jitted, donates ``state``.
+    """
+    _validate(cfg)
+    W = cfg.num_workers
+    f32 = jnp.float32
+
+    # ---- per-client gradient (the fed_worker forward_grad analog) --------
+    def grad_one(params_vec, batch, noise_rng):
+        params = unravel(params_vec)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        g, _ = ravel_pytree(grads)
+        g = g.astype(f32)
+        if cfg.weight_decay:
+            g = g + cfg.weight_decay * params_vec
+        g = clip_by_global_norm(g, cfg.max_grad_norm)
+        if cfg.dp_noise_multiplier > 0 and cfg.max_grad_norm is not None:
+            # worker-side DP: clip (above) + gaussian noise, fed_worker ~L380-420
+            sigma = cfg.dp_noise_multiplier * cfg.max_grad_norm
+            g = g + sigma * jax.random.normal(noise_rng, g.shape, f32)
+        return g, loss, aux
+
+    def local_sgd_delta(params_vec, batches, noise_rng):
+        """fedavg: num_local_iters SGD steps on the client's microbatches
+        ({k: [L, B, ...]}); transmit the weight delta (fed_worker ~L240-290)."""
+
+        def one(carry, mb):
+            p, it = carry
+            g, loss, aux = grad_one(p, mb, jax.random.fold_in(noise_rng, it))
+            return (p - cfg.local_lr * g, it + 1), (loss, aux)
+
+        (p_final, _), (losses, auxes) = jax.lax.scan(
+            one, (params_vec, jnp.zeros((), jnp.int32)), batches
+        )
+        delta = (params_vec - p_final) / cfg.local_lr  # gradient-scale transmit
+        return delta, jnp.mean(losses), jax.tree.map(partial(jnp.mean, axis=0), auxes)
+
+    lm = cfg.local_momentum
+
+    # ---- the shard body: this IS the worker process ----------------------
+    def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng):
+        # batch: one shard's {k: [w_loc, ...]}; vel/err: [w_loc, D] or ()
+        #
+        # pcast(to="varying") is load-bearing: under shard_map's vma
+        # semantics, differentiating w.r.t. a REPLICATED input auto-inserts a
+        # psum over the mesh axis in the transpose, which would hand every
+        # shard the cross-worker SUMMED gradient. Marking the param vector
+        # varying keeps AD shard-local, so per-client momentum/error/
+        # compression below see each client's own gradient; aggregation then
+        # happens exactly once, at the explicit psum.
+        params_vec = jax.lax.pcast(params_vec, WORKERS, to="varying")
+        def per_client(b, cid, vel, err):
+            noise_rng = jax.random.fold_in(rng, cid)
+            if cfg.mode == "fedavg":
+                g, loss, aux = local_sgd_delta(params_vec, b, noise_rng)
+            else:
+                g, loss, aux = grad_one(params_vec, b, noise_rng)
+            u = lm * vel + g if lm > 0 else g
+            new_vel = u
+            if cfg.mode == "local_topk":
+                e = (err + u) if cfg.error_type == "local" else u
+                t = topk_dense(e, cfg.k)
+                new_err = e - t
+                if cfg.momentum_dampening and lm > 0:
+                    new_vel = jnp.where(t != 0, 0.0, u)
+                transmit = t
+            elif cfg.mode == "sketch":
+                transmit = sketch_vec(spec, u)
+                new_err = err
+            else:  # uncompressed / true_topk / fedavg: dense transmit
+                transmit = u
+                new_err = err
+            return transmit, new_vel, new_err, loss, aux
+
+        vels = vel_rows if lm > 0 else jnp.zeros((client_ids.shape[0], 1), f32)
+        errs = err_rows if cfg.error_type == "local" else jnp.zeros(
+            (client_ids.shape[0], 1), f32
+        )
+        transmit, new_vel, new_err, loss, aux = jax.vmap(per_client)(
+            batch, client_ids, vels, errs
+        )
+        agg = jax.lax.psum(jnp.sum(transmit, axis=0), WORKERS) / W
+        loss_mean = jax.lax.psum(jnp.sum(loss), WORKERS) / W
+        aux_sum = jax.tree.map(lambda a: jax.lax.psum(jnp.sum(a, 0), WORKERS), aux)
+        return agg, loss_mean, aux_sum, new_vel, new_err
+
+    shard_spec = P(WORKERS)
+    worker_mapped = jax.shard_map(
+        worker_shard,
+        mesh=mesh,
+        in_specs=(P(), shard_spec, shard_spec, shard_spec, shard_spec, P()),
+        out_specs=(P(), P(), P(), shard_spec, shard_spec),
+    )
+
+    # ---- server update (fed_aggregator _server_helper_* ~L380-540) -------
+    def server_update(state: FedState, agg, lr):
+        rho = cfg.virtual_momentum
+        if cfg.mode == "sketch":
+            m = rho * state.momentum + agg if rho > 0 else agg
+            if cfg.error_type == "virtual":
+                e = state.error + m
+                update = unsketch(spec, e, cfg.k)
+                e = e - sketch_vec(spec, update)  # zero HH coords (linearity)
+            else:
+                e = state.error
+                update = unsketch(spec, m, cfg.k)
+            if cfg.momentum_dampening and rho > 0:
+                # zero the momentum sketch at HH coords (fed_aggregator
+                # ~L380-440): estimate m there, subtract its sketch.
+                m_at_hh = jnp.where(update != 0, estimate_all(spec, m), 0.0)
+                m = m - sketch_vec(spec, m_at_hh)
+            new_m = m if rho > 0 else state.momentum
+            return update, new_m, e
+        if cfg.mode == "true_topk":
+            m = rho * state.momentum + agg
+            if cfg.error_type == "virtual":
+                e = state.error + m
+                update = topk_dense(e, cfg.k)
+                e = e - update  # Ve[hh] = 0
+            else:
+                e = state.error
+                update = topk_dense(m, cfg.k)
+            if cfg.momentum_dampening:
+                m = jnp.where(update != 0, 0.0, m)
+            return update, m, e
+        # uncompressed / fedavg / local_topk: dense (or sparse-sum) update
+        if rho > 0:
+            m = rho * state.momentum + agg
+            return m, m, state.error
+        return agg, state.momentum, state.error
+
+    def round_fn(state: FedState, client_ids, batch, lr):
+        rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
+        vel_rows = state.client_vel[client_ids] if lm > 0 else jnp.zeros((W, 1), f32)
+        err_rows = (
+            state.client_err[client_ids]
+            if cfg.error_type == "local"
+            else jnp.zeros((W, 1), f32)
+        )
+        agg, loss, aux, new_vel, new_err = worker_mapped(
+            state.params_vec, batch, client_ids, vel_rows, err_rows, rng
+        )
+        update, new_m, new_e = server_update(state, agg, lr)
+        new_params = state.params_vec - lr * update
+        client_vel = (
+            state.client_vel.at[client_ids].set(new_vel) if lm > 0 else state.client_vel
+        )
+        client_err = (
+            state.client_err.at[client_ids].set(new_err)
+            if cfg.error_type == "local"
+            else state.client_err
+        )
+        metrics = {"loss": loss, **aux}
+        return (
+            FedState(new_params, new_m, new_e, client_vel, client_err, state.step + 1),
+            metrics,
+        )
+
+    return jax.jit(round_fn, donate_argnums=(0,))
+
+
+def build_eval_fn(loss_fn: Callable, unravel: Callable, mask_batch: Callable):
+    """Jitted eval step: (params_vec, batch-with-_valid) -> metric sums.
+
+    The reference's val path (fed_worker.py ~L290-340) runs loss + #correct
+    with no compression; here padded tail rows are masked to IGNORE_INDEX by
+    ``mask_batch(batch, valid_row_mask)`` so static shapes survive jit.
+    """
+
+    @jax.jit
+    def eval_step(params_vec, batch):
+        batch = dict(batch)
+        valid = batch.pop("_valid")
+        n = next(iter(batch.values())).shape[0]
+        row_mask = jnp.arange(n) < valid
+        batch = mask_batch(batch, row_mask)
+        params = unravel(params_vec)
+        loss, aux = loss_fn(params, batch)
+        return {"loss_sum": loss * valid.astype(jnp.float32), **aux}
+
+    return eval_step
+
+
+def mask_classification(batch, row_mask):
+    return {**batch, "y": jnp.where(row_mask, batch["y"], IGNORE_INDEX)}
+
+
+def mask_gpt2(batch, row_mask):
+    return {
+        **batch,
+        "mc_labels": jnp.where(row_mask, batch["mc_labels"], IGNORE_INDEX),
+        "lm_labels": jnp.where(
+            row_mask[:, None, None], batch["lm_labels"], IGNORE_INDEX
+        ),
+    }

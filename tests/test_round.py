@@ -1,0 +1,199 @@
+"""Round-engine tests on the virtual 8-device CPU mesh.
+
+The strategy SURVEY.md §4 demands: every compression mode is verified on a
+fake multi-device mesh against the single-device oracle, and degenerate
+settings (k=D, huge sketch, 1 local iter) must reduce exactly/approximately
+to the uncompressed path.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import FedDataset, FedSampler
+from commefficient_tpu.models.losses import classification_loss
+from commefficient_tpu.ops import ravel_params
+from commefficient_tpu.parallel import FederatedSession, make_mesh
+from commefficient_tpu.utils.config import Config
+
+
+class TinyMLP(nn.Module):
+    num_classes: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(16)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+D_IN = 8
+N_CLASSES = 4
+
+
+def _setup(num_clients=12):
+    rng = np.random.default_rng(0)
+    n = 600
+    w = rng.normal(size=(D_IN, N_CLASSES))
+    x = rng.normal(size=(n, D_IN)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.normal(size=(n, N_CLASSES)), axis=1).astype(np.int32)
+    ds = FedDataset({"x": x, "y": y}, num_clients, iid=True, seed=0)
+    model = TinyMLP()
+    params = model.init(jax.random.key(0), jnp.zeros((1, D_IN)))
+    loss_fn = classification_loss(model.apply)
+    return ds, params, loss_fn
+
+
+def _run(cfg, n_rounds=5, lr=0.3, fedavg_iters=None):
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    losses = []
+    for r in range(n_rounds):
+        ids, batch = sampler.sample_round(r)
+        if cfg.mode == "fedavg":
+            L = cfg.num_local_iters
+            batch = {k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                     for k, v in batch.items()}
+        m = sess.train_round(ids, batch, lr)
+        losses.append(float(m["loss"]))
+    return sess, losses
+
+
+def _final_vec(sess):
+    return np.asarray(sess.state.params_vec)
+
+
+BASE = dict(num_clients=12, num_workers=8, num_devices=8, local_batch_size=4,
+            weight_decay=0.0, seed=5)
+
+
+def test_uncompressed_multidevice_matches_single_device():
+    cfg8 = Config(mode="uncompressed", **BASE)
+    cfg1 = Config(mode="uncompressed", **{**BASE, "num_devices": 1})
+    s8, l8 = _run(cfg8)
+    s1, l1 = _run(cfg1)
+    np.testing.assert_allclose(l8, l1, rtol=1e-4)
+    np.testing.assert_allclose(_final_vec(s8), _final_vec(s1), atol=1e-5)
+
+
+def test_uncompressed_loss_decreases():
+    _, losses = _run(Config(mode="uncompressed", **BASE), n_rounds=12)
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_true_topk_full_k_equals_uncompressed():
+    ds, params, loss_fn = _setup()
+    d = ravel_params(params)[0].size
+    cfg_t = Config(mode="true_topk", error_type="virtual", k=int(d), **BASE)
+    cfg_u = Config(mode="uncompressed", **BASE)
+    st, _ = _run(cfg_t)
+    su, _ = _run(cfg_u)
+    np.testing.assert_allclose(_final_vec(st), _final_vec(su), atol=1e-5)
+
+
+def test_local_topk_full_k_equals_uncompressed():
+    ds, params, loss_fn = _setup()
+    d = ravel_params(params)[0].size
+    cfg_t = Config(mode="local_topk", error_type="local", k=int(d), **BASE)
+    cfg_u = Config(mode="uncompressed", **BASE)
+    st, _ = _run(cfg_t)
+    su, _ = _run(cfg_u)
+    np.testing.assert_allclose(_final_vec(st), _final_vec(su), atol=1e-5)
+
+
+def test_fedavg_one_iter_equals_uncompressed():
+    cfg_f = Config(mode="fedavg", num_local_iters=1, local_lr=0.1, **BASE)
+    cfg_u = Config(mode="uncompressed", **BASE)
+    sf, _ = _run(cfg_f, fedavg_iters=1)
+    su, _ = _run(cfg_u)
+    np.testing.assert_allclose(_final_vec(sf), _final_vec(su), atol=1e-5)
+
+
+def test_fedavg_multi_iter_loss_decreases():
+    cfg = Config(mode="fedavg", num_local_iters=4, local_lr=0.05,
+                 **{**BASE, "local_batch_size": 8})
+    _, losses = _run(cfg, n_rounds=10, lr=0.05)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_sketch_mode_trains_and_error_feedback_helps():
+    # modest sketch: still enough capacity that training converges
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=60, num_rows=5, num_cols=512, **BASE)
+    _, losses = _run(cfg, n_rounds=15)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_true_topk_sparse_with_error_feedback_trains():
+    cfg = Config(mode="true_topk", error_type="virtual", k=40,
+                 virtual_momentum=0.9, **BASE)
+    _, losses = _run(cfg, n_rounds=15)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_local_momentum_state_only_updates_participants():
+    cfg = Config(mode="local_topk", error_type="local", k=20,
+                 local_momentum=0.9, **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=8, local_batch_size=4, seed=1)
+    ids, batch = sampler.sample_round(0)
+    sess.train_round(ids, batch, 0.1)
+    vel = np.asarray(sess.state.client_vel)
+    err = np.asarray(sess.state.client_err)
+    participated = np.zeros(cfg.num_clients, bool)
+    participated[ids] = True
+    assert np.abs(vel[participated]).sum() > 0
+    assert np.abs(vel[~participated]).sum() == 0
+    assert np.abs(err[participated]).sum() > 0
+    assert np.abs(err[~participated]).sum() == 0
+
+
+def test_eval_masks_padded_rows():
+    ds, params, loss_fn = _setup()
+    cfg = Config(mode="uncompressed", **BASE)
+    sess = FederatedSession(cfg, params, loss_fn)
+    test_ds = FedDataset(
+        {"x": ds.data["x"][:10], "y": ds.data["y"][:10]}, 1, seed=0
+    )
+    out = sess.evaluate(test_ds.eval_batches(batch_size=8))  # 8 + pad(2->8)
+    assert 0.0 <= out["accuracy"] <= 1.0
+    assert np.isfinite(out["loss"])
+
+
+def test_local_topk_with_virtual_momentum_trains():
+    # regression: momentum must be allocated for dense modes beyond true_topk
+    cfg = Config(mode="local_topk", error_type="local", k=30,
+                 virtual_momentum=0.9, **BASE)
+    _, losses = _run(cfg, n_rounds=10, lr=0.1)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_sketch_momentum_dampening_zeroes_hh_coords():
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 momentum_dampening=True, k=40, num_rows=5, num_cols=1024, **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    from commefficient_tpu.ops import estimate_all
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=8, local_batch_size=4, seed=1)
+    ids, batch = sampler.sample_round(0)
+    sess.train_round(ids, batch, 0.2)
+    # after the round, the momentum sketch's estimates at the transmitted HH
+    # coords must be ~0 (they were subtracted via linearity)
+    update_coords = np.asarray(sess.state.params_vec) != np.asarray(
+        ravel_params(params)[0]
+    )
+    est = np.asarray(estimate_all(sess.spec, sess.state.momentum))
+    hh_est = est[update_coords]
+    assert np.abs(hh_est).max() < 1e-4
+
+
+def test_invalid_mode_error_combination_rejected():
+    with pytest.raises(NotImplementedError):
+        ds, params, loss_fn = _setup()
+        FederatedSession(
+            Config(mode="sketch", error_type="local", **BASE), params, loss_fn
+        )
