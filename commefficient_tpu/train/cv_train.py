@@ -1,0 +1,175 @@
+"""cv_train — the CV workload entry point.
+
+Reference: ``CommEfficient/cv_train.py`` ~L30-240 (SURVEY.md §2 "cv_train
+entry", §3.1): CLI -> federated dataset + sampler -> FedModel/FedOptimizer
+-> epoch loop with the piecewise-linear LR (0 -> lr_scale @ pivot_epoch ->
+0), per-epoch validation, console table + metrics logging.
+
+Run-command parity examples:
+
+  python -m commefficient_tpu.train.cv_train --mode uncompressed \
+      --num_workers 1 --num_devices 1 --num_epochs 2          # BASELINE #1
+  python -m commefficient_tpu.train.cv_train --mode sketch --k 50000 \
+      --num_rows 5 --num_cols 500000 --virtual_momentum 0.9 \
+      --error_type virtual --num_workers 8 --num_devices 8    # BASELINE #2
+  python -m commefficient_tpu.train.cv_train --dataset_name femnist \
+      --mode local_topk --error_type local --num_clients 100  # BASELINE #3
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.data import (
+    FedSampler,
+    augment_batch,
+    load_fed_cifar10,
+    load_fed_emnist,
+    load_fed_imagenet,
+)
+from commefficient_tpu.models import ResNet9, classification_loss, fixup_resnet50
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils import (
+    Config,
+    MetricsWriter,
+    TableLogger,
+    Timer,
+    parse_args,
+    piecewise_linear_lr,
+)
+from commefficient_tpu.utils.logging import make_logdir
+
+
+def build_model_and_data(cfg: Config):
+    """Dataset + model for cfg.dataset_name / cfg.model."""
+    if cfg.dataset_name == "cifar10":
+        train, test, real = load_fed_cifar10(
+            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
+        )
+        sample_shape = (1, 32, 32, 3)
+        num_classes = cfg.num_classes
+        augment = augment_batch
+    elif cfg.dataset_name == "femnist":
+        train, test, real = load_fed_emnist(
+            cfg.dataset_dir, num_clients=cfg.num_clients, seed=cfg.seed
+        )
+        sample_shape = (1, 28, 28, 1)
+        num_classes = 62
+        augment = None
+    elif cfg.dataset_name == "imagenet":
+        train, test, real = load_fed_imagenet(
+            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
+        )
+        sample_shape = (1,) + train.data["x"].shape[1:]
+        num_classes = cfg.num_classes
+        augment = None
+    else:
+        raise ValueError(f"unknown dataset {cfg.dataset_name!r}")
+
+    if cfg.model == "resnet9":
+        model = ResNet9(num_classes=num_classes)
+    elif cfg.model in ("fixup_resnet50", "resnet50"):
+        model = fixup_resnet50(num_classes=num_classes)
+    else:
+        raise ValueError(f"unknown model {cfg.model!r}")
+    params = model.init(jax.random.key(cfg.seed), jnp.zeros(sample_shape))
+    loss_fn = classification_loss(model.apply)
+    return train, test, real, model, params, loss_fn, augment
+
+
+def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
+               test_ds, writer: Optional[MetricsWriter] = None,
+               table: Optional[TableLogger] = None, eval_batch_size: int = 512):
+    """The epoch loop (cv_train.py ~L120-240). Returns final val metrics."""
+    steps_per_epoch = sampler.steps_per_epoch()
+    lr_fn = partial(
+        piecewise_linear_lr,
+        steps_per_epoch=steps_per_epoch,
+        pivot_epoch=cfg.pivot_epoch,
+        num_epochs=cfg.num_epochs,
+        lr_scale=cfg.lr_scale,
+    )
+    table = table or TableLogger()
+    timer = Timer()
+    val = {}
+    step = 0
+    for epoch in range(cfg.num_epochs):
+        timer()
+        train_loss, train_correct, train_count = 0.0, 0.0, 0.0
+        for client_ids, batch in sampler.epoch(epoch):
+            if cfg.mode == "fedavg":
+                L = cfg.num_local_iters
+                batch = {
+                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                    for k, v in batch.items()
+                }
+            lr = float(lr_fn(step))
+            metrics = session.train_round(client_ids, batch, lr)
+            train_loss += float(metrics["loss"])
+            train_correct += float(metrics.get("correct", 0.0))
+            train_count += float(metrics.get("count", 0.0))
+            if writer:
+                writer.scalar("train/loss", float(metrics["loss"]), step)
+                writer.scalar("lr", lr, step)
+            step += 1
+        train_time = timer()
+        val = session.evaluate(test_ds.eval_batches(eval_batch_size))
+        val_time = timer()
+        row = {
+            "epoch": epoch + 1,
+            "lr": lr,
+            "train_loss": train_loss / steps_per_epoch,
+            "train_acc": train_correct / max(train_count, 1.0),
+            "val_loss": val["loss"],
+            "val_acc": val.get("accuracy", float("nan")),
+            "train_time": train_time,
+            "val_time": val_time,
+        }
+        table.append(row)
+        if writer:
+            writer.scalar("val/loss", val["loss"], step)
+            writer.scalar("val/acc", val.get("accuracy", 0.0), step)
+            writer.flush()
+    return val
+
+
+def main(argv=None, **overrides):
+    cfg = parse_args(argv, **overrides)
+    train, test, real, model, params, loss_fn, augment = build_model_and_data(cfg)
+    print(
+        f"dataset={cfg.dataset_name} (real={real}) model={cfg.model} "
+        f"mode={cfg.mode} clients={train.num_clients} workers={cfg.num_workers} "
+        f"devices={cfg.num_devices}"
+    )
+    if not real:
+        print("WARNING: real dataset not found on disk — synthetic stand-in "
+              "(pipeline-correct; metrics are not paper numbers)")
+    session = FederatedSession(cfg, params, loss_fn)
+    bpr = session.bytes_per_round()
+    print(f"grad_size D={session.grad_size}  upload/client/round="
+          f"{bpr['upload_bytes']:,} B  download={bpr['download_bytes']:,} B")
+    sampler = FedSampler(
+        train,
+        num_workers=cfg.num_workers,
+        local_batch_size=cfg.local_batch_size
+        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
+        seed=cfg.seed,
+        augment=augment,
+    )
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
+    try:
+        val = train_loop(cfg, session, sampler, test, writer)
+    finally:
+        writer.close()
+    print(f"final: val_loss={val['loss']:.4f} val_acc={val.get('accuracy', 0):.4f}")
+    return val
+
+
+if __name__ == "__main__":
+    main()
