@@ -1,0 +1,38 @@
+"""Platform forcing: run multi-device code on a virtual CPU mesh.
+
+The ambient environment pins jax to the single real TPU chip via the "axon"
+PJRT plugin, whose sitecustomize hook (a) imports jax at interpreter start,
+(b) force-sets ``jax_platforms=axon`` and (c) monkey-patches backend lookup
+so the first jax op dials the TPU tunnel. For the test suite and the
+driver's ``dryrun_multichip`` we instead want N virtual CPU devices
+(``--xla_force_host_platform_device_count``) — the TPU-world analog of the
+reference's virtual-worker simulation (SURVEY.md §4).
+
+``force_virtual_cpu_devices(n)`` neutralizes all three hooks. It must run
+BEFORE any jax backend initializes (importing jax is fine; running an op is
+not). Used by ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_virtual_cpu_devices(n: int = 8) -> None:
+    """Pin jax to ``n`` virtual CPU devices, deregistering the axon TPU hook.
+
+    Idempotent; safe to call multiple times with the same ``n``. Raises if a
+    conflicting device count was already baked into an initialized backend.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+    import jax  # local import: sitecustomize may have imported it already
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")

@@ -3,31 +3,16 @@
 This is the TPU-world analog of the reference's virtual-worker simulation
 (SURVEY.md §4): multi-device semantics are exercised on CPU with
 ``--xla_force_host_platform_device_count=8`` so every shard_map/psum path is
-tested without real chips.
-
-The ambient environment pins jax to the single real TPU chip via the "axon"
-PJRT plugin, whose sitecustomize hook (a) imports jax at interpreter start,
-(b) force-sets ``jax_platforms=axon`` and (c) monkey-patches backend lookup
-so the first jax op dials the TPU tunnel — far too slow (and single-device)
-for a test suite. We neutralize all three here: deregister the axon backend
-factory before any backend initializes, and pin platforms back to cpu.
-bench.py is the path that intentionally uses the real chip.
+tested without real chips. The axon-TPU neutralization lives in
+``commefficient_tpu.utils.platform`` (shared with the driver's
+``__graft_entry__.dryrun_multichip``).
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402  (sitecustomize may have imported it already)
-from jax._src import xla_bridge as _xb  # noqa: E402
+from commefficient_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
+force_virtual_cpu_devices(8)
