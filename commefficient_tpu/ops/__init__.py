@@ -4,12 +4,20 @@ from commefficient_tpu.ops.countsketch import (
     CountSketch,
     sketch_vec,
     sketch_add_vec,
+    sketch_sparse,
     unsketch,
+    unsketch_dense,
+    unsketch_sparse,
     estimate_all,
     estimate_at,
     l2_estimate,
 )
-from commefficient_tpu.ops.topk import topk_sparsify, topk_dense, mask_out_indices
+from commefficient_tpu.ops.topk import (
+    topk_sparsify,
+    topk_dense,
+    topk_threshold_dense,
+    mask_out_indices,
+)
 from commefficient_tpu.ops.param_utils import (
     ravel_params,
     make_unraveler,
@@ -20,7 +28,11 @@ __all__ = [
     "CountSketch",
     "sketch_vec",
     "sketch_add_vec",
+    "sketch_sparse",
     "unsketch",
+    "unsketch_dense",
+    "unsketch_sparse",
+    "topk_threshold_dense",
     "estimate_all",
     "estimate_at",
     "l2_estimate",

@@ -8,29 +8,37 @@ than translated from CUDA.
 
 Why not the classic layout: the reference scatters each coordinate to a
 random bucket (``scatter_add``) and gathers random buckets back — on GPUs
-those are atomic-add/gather at memory bandwidth, on TPU both run at ~100M
-elem/s (measured ~55 ms per row at d=6.5M: a 4000x bandwidth shortfall,
-because the TPU is a contiguous-vector machine with no fast random access).
+those are atomic-add/gather at memory bandwidth, but the TPU is a
+contiguous-vector machine with no fast random access (measured on v5e:
+a 50k-element scatter into 6.5M costs ~24 ms — microseconds of matmul).
 
-Blocked design (this module):
+Blocked design (this module, v2):
   * Coordinates are split into contiguous CHUNKS of ``m``; each chunk owns a
     private block of ``s`` buckets, so the table has ``c = ceil(d/m) * s``
-    columns. Within a chunk, the bucket is a murmur-style hash of the
-    coordinate -> one-hot matmul ``[m] x [m, s]`` on the MXU. No scatter.
+    columns. Within a chunk, the bucket of a coordinate is a murmur-style
+    hash of its WITHIN-CHUNK OFFSET, shared across chunks — so one static
+    ``[m, s]`` one-hot matrix realizes the whole row as a single
+    ``[nc, m] x [m, s]`` MXU matmul. No scatter, no per-chunk one-hot
+    materialization (v1 generated ``d*s`` one-hot entries on the VPU per
+    row — 30-50x slower than the MXU matmul).
   * Per-row CYCLIC ROLL of the coordinate axis (a contiguous memory op)
     shifts chunk boundaries, and ALTERNATE ROWS use a STRIDED chunk layout
     (coordinate p -> chunk p mod nc, realized as a transpose — another
-    contiguous op): a pair of coordinates that shares a chunk in the
-    contiguous rows is spread across chunks in the strided rows, so no pair
-    collides in every row and the median rejects clustered-heavy-hitter
-    crowding. Per-row SIGNS make residual collision terms zero-mean.
-  * Estimation is the transposed one-hot matmul (again MXU), followed by
-    median across rows — no gather.
+    contiguous op): a pair of coordinates that shares a chunk (hence a
+    possibly-colliding bucket) in the contiguous rows is spread across
+    chunks in the strided rows, so no pair collides in every row and the
+    median rejects clustered-heavy-hitter crowding. Per-row SIGNS (hashed
+    from the ORIGINAL coordinate) make residual collision terms zero-mean.
+  * Estimation is the transposed matmul ``[nc, s] x [s, m]`` (again MXU),
+    followed by median across rows — no gather.
 
-Variance matches the classic sketch at equal table size: a coordinate's
-collision noise is ||v_chunk||^2/s ~= ||v||^2 * (m/d)/s = ||v||^2/c.
-Measured on one v5p chip at d=6.5M, r=5, c~=820k: accumulate 12 ms,
-full-d estimate 18 ms (vs 237/253 ms for the scatter/gather layout).
+Sharing the offset-keyed hash across chunks does not change the collision
+statistics that matter: collisions only exist WITHIN a chunk (each chunk
+owns its own bucket block), a pair in the same chunk collides with
+probability 1/s per row exactly as in the classic sketch, and rows stay
+independent (per-row hash keys + roll + stride). Variance matches the
+classic sketch at equal table size: a coordinate's collision noise is
+||v_chunk||^2/s ~= ||v||^2 * (m/d)/s = ||v||^2/c.
 
 Linearity is the contract that makes federated aggregation exact:
 ``sketch(a) + sketch(b) == sketch(a + b)`` (bit-exact in float32 mode up to
@@ -39,8 +47,7 @@ summed update.
 
 ``num_blocks`` from the reference API (hash-reuse chunking for GPU memory,
 csvec.py ~L60-100) is accepted for config parity but unused: the blocked
-layout is already tiled, and ``lax.map`` over chunk batches bounds peak
-memory regardless of d.
+layout is already tiled and no transient exceeds the table size.
 
 All functions are pure and jit/vmap/shard_map-friendly.
 """
@@ -56,8 +63,6 @@ import numpy as np
 _M1 = np.uint32(0x85EBCA6B)
 _M2 = np.uint32(0xC2B2AE35)
 _GOLDEN = np.uint32(0x9E3779B9)
-
-_CHUNK_BATCH = 512  # chunks per lax.map step: bounds transient memory
 
 
 def _mix32(x: jnp.ndarray, key) -> jnp.ndarray:
@@ -88,13 +93,22 @@ class CountSketch(NamedTuple):
     r: int  # rows (independent repetitions; median across them)
     num_blocks: int = 1  # reference-API parity; unused (see module docstring)
     seed: int = 42  # hash seed; equal seeds => equal hashes everywhere
-    m: int = 512  # chunk size (coordinates per bucket block)
+    m: Any = None  # chunk size (coords per bucket block); None = adaptive
     dtype: Any = jnp.float32  # matmul dtype; bfloat16 halves time on MXU
 
     # -- derived static geometry ------------------------------------------
     @property
     def chunk_m(self) -> int:
-        return min(self.m, _ceil_mult(self.d, 8))
+        """Chunk size. Adaptive default: grow m (512..8192, powers of 2)
+        until each chunk gets >= 32 buckets, so the per-chunk floor of 8
+        can't inflate the realized table far beyond the request at large
+        d/c ratios (GPT-2 scale: d=124M, c=1.25M needs m=4096)."""
+        if self.m is not None:
+            return min(self.m, _ceil_mult(self.d, 8))
+        m = 512
+        while m < 8192 and self.d / m > self.c / 32:
+            m *= 2
+        return min(m, _ceil_mult(self.d, 8))
 
     @property
     def nc(self) -> int:
@@ -103,7 +117,7 @@ class CountSketch(NamedTuple):
     @property
     def s(self) -> int:
         raw = max(1, round(self.c / self.nc))
-        return max(8, _ceil_mult(raw, 8))
+        return max(8, round(raw / 8) * 8)  # nearest multiple of 8
 
     @property
     def c_actual(self) -> int:
@@ -137,21 +151,33 @@ class CountSketch(NamedTuple):
         return row % 2 == 1 and self.nc > 1
 
     def _row_signs(self, row: int) -> jnp.ndarray:
+        """[d_padded] ±1, hashed from the ORIGINAL coordinate index."""
         idx = jnp.arange(self.d_padded, dtype=jnp.uint32)
         bits = _mix32(idx, self._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
         return 1.0 - 2.0 * bits.astype(jnp.float32)
 
-    def _row_slots(self, row: int) -> jnp.ndarray:
-        """[nc, m] int32 bucket slot per LAYOUT CELL; hash keyed by the
-        rolled position held in that cell, so sketch/estimate/estimate_at
-        agree on a single definition."""
-        idx = jnp.arange(self.d_padded, dtype=jnp.uint32)
-        h = (_mix32(idx, self._row_key(row)) % jnp.uint32(self.s)).astype(jnp.int32)
-        return _to_layout(self, h, row)
+    def _offset_slots(self, row: int) -> jnp.ndarray:
+        """[m] int32 bucket per within-chunk offset (shared by all chunks)."""
+        off = jnp.arange(self.chunk_m, dtype=jnp.uint32)
+        return (_mix32(off, self._row_key(row)) % jnp.uint32(self.s)).astype(
+            jnp.int32
+        )
+
+    def _row_onehot(self, row: int) -> jnp.ndarray:
+        """[m, s] static one-hot of ``_offset_slots`` — the whole row's hash
+        as one small matmul operand."""
+        slots = self._offset_slots(row)
+        return (slots[:, None] == jnp.arange(self.s, dtype=jnp.int32)).astype(
+            self.dtype
+        )
 
 
 def _to_layout(spec: "CountSketch", x_flat: jnp.ndarray, row: int) -> jnp.ndarray:
-    """[d_padded] position-ordered -> [nc, m] chunk layout for this row."""
+    """[d_padded] position-ordered -> [nc, m] chunk layout for this row.
+
+    Contiguous rows: position p -> (chunk p // m, offset p % m).
+    Strided rows:    position p -> (chunk p % nc, offset p // nc).
+    """
     if spec._strided(row):
         return x_flat.reshape(spec.chunk_m, spec.nc).T
     return x_flat.reshape(spec.nc, spec.chunk_m)
@@ -168,33 +194,15 @@ def _ceil_mult(x: int, q: int) -> int:
     return -(-x // q) * q
 
 
-def _batched(nc: int) -> tuple[int, int]:
-    """(batch, padded_nc) for lax.map over chunk batches."""
-    b = min(_CHUNK_BATCH, nc)
-    return b, _ceil_mult(nc, b)
-
-
-def _pad_chunks(x: jnp.ndarray, nc_pad: int) -> jnp.ndarray:
-    return jnp.pad(x, ((0, nc_pad - x.shape[0]),) + ((0, 0),) * (x.ndim - 1))
-
-
 def _sketch_one_row(spec: CountSketch, v_padded: jnp.ndarray, row: int) -> jnp.ndarray:
-    sv = (v_padded * spec._row_signs(row))
+    sv = v_padded * spec._row_signs(row)
     sv = _to_layout(spec, jnp.roll(sv, spec._roll(row)), row)
-    slots = spec._row_slots(row)
-    b, nc_pad = _batched(spec.nc)
-    sv = _pad_chunks(sv, nc_pad).reshape(-1, b, spec.chunk_m)
-    slots = _pad_chunks(slots, nc_pad).reshape(-1, b, spec.chunk_m)
-
-    def block(args):
-        vcb, hb = args
-        onehot = (hb[..., None] == jnp.arange(spec.s, dtype=jnp.int32)).astype(spec.dtype)
-        return jnp.einsum(
-            "cm,cms->cs", vcb.astype(spec.dtype), onehot,
-            preferred_element_type=jnp.float32,
-        )
-
-    out = jax.lax.map(block, (sv, slots)).reshape(-1, spec.s)[: spec.nc]
+    out = jnp.einsum(
+        "cm,ms->cs",
+        sv.astype(spec.dtype),
+        spec._row_onehot(row),
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(spec.c_actual)
 
 
@@ -217,20 +225,12 @@ def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp
 
 def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
     tab = table_row.reshape(spec.nc, spec.s)
-    slots = spec._row_slots(row)
-    b, nc_pad = _batched(spec.nc)
-    tab = _pad_chunks(tab, nc_pad).reshape(-1, b, spec.s)
-    slots = _pad_chunks(slots, nc_pad).reshape(-1, b, spec.chunk_m)
-
-    def block(args):
-        tb, hb = args
-        onehot = (hb[..., None] == jnp.arange(spec.s, dtype=jnp.int32)).astype(spec.dtype)
-        return jnp.einsum(
-            "cms,cs->cm", onehot, tb.astype(spec.dtype),
-            preferred_element_type=jnp.float32,
-        )
-
-    est = jax.lax.map(block, (tab, slots)).reshape(-1, spec.chunk_m)[: spec.nc]
+    est = jnp.einsum(
+        "cs,ms->cm",
+        tab.astype(spec.dtype),
+        spec._row_onehot(row),
+        preferred_element_type=jnp.float32,
+    )
     est = jnp.roll(_from_layout(spec, est, row), -spec._roll(row))
     return est * spec._row_signs(row)
 
@@ -239,8 +239,8 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
     """Median-of-rows estimates for ALL d coordinates.
 
     ``CSVec._findAllValues`` analog (csvec.py ~L190-260): per row, gather
-    each coordinate's bucket value times sign (here: transposed one-hot
-    matmul), then median across the r estimates.
+    each coordinate's bucket value times sign (here: transposed matmul),
+    then median across the r estimates.
     """
     ests = jnp.stack(
         [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
@@ -248,47 +248,96 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
     return jnp.median(ests, axis=0)[: spec.d]
 
 
+def _row_cols_signs(spec: CountSketch, idx: jnp.ndarray, row: int):
+    """(column index, sign) of each ORIGINAL coordinate in ``idx`` for one
+    row — the gather/scatter-side view of the same mapping
+    ``_sketch_one_row`` realizes with roll + layout + one-hot matmul."""
+    idx = idx.astype(jnp.uint32)
+    pos = (idx + jnp.uint32(spec._roll(row) % spec.d_padded)) % jnp.uint32(
+        spec.d_padded
+    )
+    if spec._strided(row):
+        chunk = (pos % jnp.uint32(spec.nc)).astype(jnp.int32)
+        off = pos // jnp.uint32(spec.nc)
+    else:
+        chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
+        off = pos % jnp.uint32(spec.chunk_m)
+    h = (_mix32(off, spec._row_key(row)) % jnp.uint32(spec.s)).astype(jnp.int32)
+    # signs are keyed by the ORIGINAL coordinate (applied pre-roll in
+    # _sketch_one_row), slots by the within-chunk offset
+    bits = _mix32(idx, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
+    sign = 1.0 - 2.0 * bits.astype(jnp.float32)
+    return chunk * spec.s + h, sign
+
+
 def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Median-of-rows point estimates for a subset of coordinates
     (``CSVec._findValues`` analog, csvec.py ~L190-230). Small-k gather path."""
-    idx = idx.astype(jnp.uint32)
 
     def one_row(row: int):
-        pos = (idx + jnp.uint32(spec._roll(row) % spec.d_padded)) % jnp.uint32(
-            spec.d_padded
-        )
-        if spec._strided(row):
-            chunk = (pos % jnp.uint32(spec.nc)).astype(jnp.int32)
-        else:
-            chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
-        h = (_mix32(pos, spec._row_key(row)) % jnp.uint32(spec.s)).astype(jnp.int32)
-        # signs are keyed by the ORIGINAL coordinate (applied pre-roll in
-        # _sketch_one_row), slots by the rolled position
-        bits = _mix32(idx, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
-        sign = 1.0 - 2.0 * bits.astype(jnp.float32)
-        return table[row, chunk * spec.s + h] * sign
+        cols, sign = _row_cols_signs(spec, idx, row)
+        return table[row, cols] * sign
 
     ests = jnp.stack([one_row(r) for r in range(spec.r)])
     return jnp.median(ests, axis=0)
 
 
-def unsketch(
+def sketch_sparse(spec: CountSketch, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Sketch a k-sparse vector given as (indices [k], values [k]).
+
+    Identical result to ``sketch_vec`` of the dense materialization (same
+    hash mapping, see ``_row_cols_signs``) via O(r·k) scatter-adds. NB on
+    TPU a dense ``sketch_vec`` matmul often beats this for k ≳ 10^4 —
+    scatter is the slow path on this hardware; this exists for small-k and
+    host-side uses. Coordinates may repeat; repeats accumulate.
+    """
+    vals = vals.astype(jnp.float32)
+
+    def one_row(row: int):
+        cols, sign = _row_cols_signs(spec, idx, row)
+        return jnp.zeros((spec.c_actual,), jnp.float32).at[cols].add(vals * sign)
+
+    return jnp.stack([one_row(r) for r in range(spec.r)])
+
+
+def unsketch_sparse(
     spec: CountSketch, table: jnp.ndarray, k: int, *, approx: bool = False
-) -> jnp.ndarray:
-    """Recover the top-k heavy hitters as a dense [d] vector with k nonzeros.
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Recover the top-k heavy hitters as (indices [k], values [k]).
 
     ``CSVec.unSketch`` analog (csvec.py ~L260-290): median estimates for all
-    coordinates, then global top-k by magnitude, then scatter back to dense.
-    ``approx=True`` uses ``lax.approx_max_k`` (TPU-native, ~2x faster,
-    ~0.95 recall) — callers opt in.
+    coordinates, then global top-k by magnitude. ``approx=True`` uses
+    ``lax.approx_max_k`` (TPU-native, faster, ~0.95 recall) — callers opt in.
     """
     est = estimate_all(spec, table)
     if approx:
         _, hh_idx = jax.lax.approx_max_k(jnp.abs(est), k)
     else:
         _, hh_idx = jax.lax.top_k(jnp.abs(est), k)
-    out = jnp.zeros(spec.d, dtype=est.dtype)
-    return out.at[hh_idx].set(est[hh_idx])
+    return hh_idx, est[hh_idx]
+
+
+def unsketch(
+    spec: CountSketch, table: jnp.ndarray, k: int, *, approx: bool = False
+) -> jnp.ndarray:
+    """``unsketch_sparse`` materialized as a dense [d] vector, k nonzeros."""
+    hh_idx, vals = unsketch_sparse(spec, table, k, approx=approx)
+    return jnp.zeros(spec.d, dtype=vals.dtype).at[hh_idx].set(vals)
+
+
+def unsketch_dense(spec: CountSketch, table: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Top-k heavy hitters as a dense [d] vector via THRESHOLD selection —
+    no sort, no scatter (both are slow on TPU; see ops.topk).
+
+    Same contract as ``unsketch`` except selection is by a binary-searched
+    magnitude threshold, so the nonzero count is ≤ k (ties at the threshold
+    are dropped rather than arbitrarily broken — at most a handful of
+    coordinates on float gradients).
+    """
+    from commefficient_tpu.ops.topk import topk_threshold_dense
+
+    est = estimate_all(spec, table)
+    return topk_threshold_dense(est, k)
 
 
 def l2_estimate(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:

@@ -30,6 +30,38 @@ def topk_dense(v: jnp.ndarray, k: int, *, approx: bool = False) -> jnp.ndarray:
     return jnp.zeros_like(v).at[idx].set(vals)
 
 
+def topk_threshold_dense(v: jnp.ndarray, k: int, iters: int = 32) -> jnp.ndarray:
+    """Dense top-≤k by magnitude via binary-searched threshold — the TPU
+    fast path: no sort (lax.top_k is ~40 ms at d=6.5M on v5e) and no
+    scatter (~24 ms for 50k updates), just ``iters`` vectorized passes over
+    |v| (~33 µs each at d=6.5M).
+
+    Selects ``|v| >= t`` for the smallest tested ``t`` whose selection count
+    is ≤ k, so the result has AT MOST k nonzeros; exact ties at the
+    threshold are dropped rather than arbitrarily broken (on float gradient
+    vectors this loses at most a handful of coordinates vs. exact top-k).
+    """
+    mag = jnp.abs(v)
+    hi0 = jnp.max(mag)
+
+    def body(_, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        too_many = jnp.sum(mag >= mid) > k
+        return jnp.where(too_many, mid, lo), jnp.where(too_many, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(
+        0, iters, body, (jnp.zeros((), mag.dtype), hi0)
+    )
+    # hi is the smallest tested threshold with count <= k; (mag > 0) guards
+    # the all-zero vector (hi stays 0 there and >= would select everything).
+    # Degenerate case: >k coordinates tie at the max, so NO magnitude
+    # threshold selects <=k — honor the at-most-k contract by dropping the
+    # tied set entirely (error feedback retains it for later rounds).
+    hi = jnp.where(jnp.sum(mag >= hi) > k, jnp.inf, hi)
+    return v * ((mag >= hi) & (mag > 0))
+
+
 def mask_out_indices(v: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Zero the given coordinates — the error-feedback "forget what was sent"
     step (``Ve[hh]=0`` in fed_aggregator.py ~L440-480)."""

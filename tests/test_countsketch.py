@@ -14,7 +14,9 @@ import pytest
 from commefficient_tpu.ops import (
     CountSketch,
     sketch_vec,
+    sketch_sparse,
     unsketch,
+    unsketch_sparse,
     estimate_all,
     l2_estimate,
 )
@@ -120,9 +122,10 @@ def test_hash_quality(spec):
     """Slots roughly uniform; signs roughly balanced; rows decorrelated."""
     all_slots = []
     for row in range(R):
-        slots = np.asarray(spec._row_slots(row)).ravel()
+        slots = np.asarray(spec._offset_slots(row))  # [m] per-offset buckets
         counts = np.bincount(slots, minlength=spec.s)
-        assert counts.max() < 3 * (spec.d_padded / spec.s)
+        # m balls into s bins: max load within a small factor of the mean
+        assert counts.max() <= 4 * max(1.0, spec.chunk_m / spec.s)
         signs = np.asarray(spec._row_signs(row))
         assert abs(signs.mean()) < 0.05
         all_slots.append(slots)
@@ -130,7 +133,7 @@ def test_hash_quality(spec):
     for i in range(R):
         for j in range(i + 1, R):
             agree = np.mean(all_slots[i] == all_slots[j])
-            assert abs(agree - 1.0 / spec.s) < 0.02
+            assert abs(agree - 1.0 / spec.s) < 0.05
 
 
 def test_rolls_differ_across_rows(spec):
@@ -154,6 +157,82 @@ def test_recovers_clustered_heavy_hitters(spec):
     rec = unsketch(spec, table, k=20)
     rec_idx = set(np.nonzero(np.asarray(rec))[0].tolist())
     assert set(hh.tolist()) <= rec_idx
+
+
+def test_sketch_sparse_matches_dense_sketch(spec):
+    """sketch_sparse of (idx, vals) == sketch_vec of the dense materialization
+    — the server's fast path for subtracting the k-sparse extracted update."""
+    rng = np.random.default_rng(11)
+    idx = jnp.asarray(rng.choice(D, size=50, replace=False).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=50).astype(np.float32) * 10)
+    dense = jnp.zeros(D, jnp.float32).at[idx].set(vals)
+    np.testing.assert_allclose(
+        np.asarray(sketch_sparse(spec, idx, vals)),
+        np.asarray(sketch_vec(spec, dense)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_error_feedback_subtraction_zeroes_estimates(spec):
+    """After e -= sketch_sparse(hh, est(hh)), estimates at hh are exactly 0 —
+    the linearity identity the server's error feedback relies on."""
+    rng = np.random.default_rng(12)
+    v, hh = planted_vector(D, 10, rng)
+    table = sketch_vec(spec, v)
+    hh_idx = jnp.asarray(hh.astype(np.int32))
+    vals = estimate_at(spec, table, hh_idx)
+    table2 = table - sketch_sparse(spec, hh_idx, vals)
+    np.testing.assert_allclose(
+        np.asarray(estimate_at(spec, table2, hh_idx)), 0.0, atol=1e-4
+    )
+
+
+def test_unsketch_sparse_matches_dense(spec):
+    rng = np.random.default_rng(13)
+    v, _ = planted_vector(D, 15, rng)
+    table = sketch_vec(spec, v)
+    idx, vals = unsketch_sparse(spec, table, k=15)
+    dense = unsketch(spec, table, k=15)
+    np.testing.assert_allclose(
+        np.asarray(dense)[np.asarray(idx)], np.asarray(vals), rtol=1e-6
+    )
+
+
+def test_bfloat16_sketch_recovers_heavy_hitters():
+    """The bf16 MXU path must still recover planted heavy hitters (values
+    within bf16-resolution tolerance)."""
+    sp = CountSketch(d=D, c=C, r=R, seed=7, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(14)
+    v, hh = planted_vector(D, 10, rng)
+    rec = unsketch(sp, sketch_vec(sp, v), k=10)
+    rec_idx = set(np.nonzero(np.asarray(rec))[0].tolist())
+    assert set(hh.tolist()) <= rec_idx
+    np.testing.assert_allclose(
+        np.asarray(rec)[hh], np.asarray(v)[hh], rtol=0.2, atol=3.0
+    )
+
+
+def test_gpt2_scale_spec_geometry():
+    """BASELINE config #4 scale (D ~= 124M): the realized table stays within
+    a few percent of the requested num_rows*num_cols and its memory is the
+    communication budget, not a D-sized buffer (sketch-mode memory check)."""
+    d = 124_439_808  # GPT-2-small + specials, flattened
+    sp = CountSketch(d=d, c=1_250_000, r=5, seed=1)
+    r, c_actual = sp.table_shape
+    assert r == 5
+    assert abs(c_actual - 1_250_000) / 1_250_000 < 0.25
+    table_mb = r * c_actual * 4 / 2**20
+    assert table_mb < 40  # vs ~475 MB for one dense [D] f32 vector
+    # per-coordinate mapping stays consistent at this scale
+    idx = jnp.asarray([0, 1, d // 2, d - 1], jnp.int32)
+    cols, signs = zip(*[
+        __import__("commefficient_tpu.ops.countsketch", fromlist=["x"])
+        ._row_cols_signs(sp, idx, row)
+        for row in range(sp.r)
+    ])
+    for c in cols:
+        assert int(jnp.max(c)) < c_actual and int(jnp.min(c)) >= 0
 
 
 def test_jit_and_grad_safety(spec):
