@@ -9,8 +9,8 @@ device programs would only add an HBM round-trip. The call *sequence* is
 preserved:
 
     metrics = fed_model(client_ids, batch)   # runs the fused round at
-    fed_opt.step()                           # the current LR; step() advances
-                                             # the schedule clock
+    fed_opt.step()                           # fed_opt's current LR; step()
+                                             # advances the schedule clock
 
 Deviation from the reference, by design: ``__call__`` already applies the
 update (there is no observable intermediate state between the two calls in
@@ -34,12 +34,21 @@ from commefficient_tpu.parallel.round import (
     build_round_fn,
     init_state,
     mask_classification,
+    needs_client_err,
+    needs_client_vel,
 )
 from commefficient_tpu.utils.config import Config
 
 
 class FederatedSession:
-    """Owns the mesh, the jitted round, and the FedState."""
+    """Owns the mesh, the jitted round, and the FedState.
+
+    With ``cfg.offload_client_state`` the [num_clients, D] per-client
+    momentum/error stores live in host RAM (numpy) — the analog of the
+    reference's shm ``client_velocities`` (fed_aggregator.py ~L60-130), but
+    deliberately host-resident so GPT-2-scale ``num_clients x 124M`` state
+    never has to fit HBM; only the round's W participant rows cross PCIe.
+    """
 
     def __init__(
         self,
@@ -64,43 +73,84 @@ class FederatedSession:
                 r=cfg.num_rows,
                 num_blocks=cfg.num_blocks,
                 seed=cfg.seed,
+                dtype=jnp.bfloat16 if cfg.sketch_dtype == "bfloat16" else jnp.float32,
             )
         self.state = init_state(cfg, vec, self.spec)
+        self.host_vel = self.host_err = None
+        if cfg.offload_client_state:
+            if needs_client_vel(cfg):
+                self.host_vel = np.zeros((cfg.num_clients, self.grad_size), np.float32)
+            if needs_client_err(cfg):
+                self.host_err = np.zeros((cfg.num_clients, self.grad_size), np.float32)
         self.round_fn = build_round_fn(cfg, loss_fn, unravel, self.mesh, self.spec)
         self.eval_fn = build_eval_fn(eval_loss_fn or loss_fn, unravel, mask_batch)
         self._batch_sharding = worker_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
+        self._n_mesh_devices = self.mesh.devices.size
 
     # -- train ------------------------------------------------------------
     def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray], lr: float):
-        ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
+        cids = np.asarray(client_ids)
+        ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         dev_batch = jax.tree.map(
             lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding), batch
         )
-        self.state, metrics = self.round_fn(
-            self.state, ids, dev_batch, jnp.float32(lr)
+        lr = jnp.float32(lr)
+        if not self.cfg.offload_client_state:
+            self.state, metrics = self.round_fn(self.state, ids, dev_batch, lr)
+            return metrics
+        vel_rows = (
+            jax.device_put(jnp.asarray(self.host_vel[cids]), self._batch_sharding)
+            if self.host_vel is not None
+            else ()
         )
+        err_rows = (
+            jax.device_put(jnp.asarray(self.host_err[cids]), self._batch_sharding)
+            if self.host_err is not None
+            else ()
+        )
+        self.state, metrics, new_vel, new_err = self.round_fn(
+            self.state, ids, dev_batch, lr, vel_rows, err_rows
+        )
+        if self.host_vel is not None:
+            self.host_vel[cids] = np.asarray(new_vel)
+        if self.host_err is not None:
+            self.host_err[cids] = np.asarray(new_err)
         return metrics
 
     # -- eval -------------------------------------------------------------
+    def _put_eval_batch(self, b: Dict[str, np.ndarray]):
+        """Shard eval batch rows over the mesh so validation uses every chip
+        (the reference round-robins val across workers, fed_worker ~L290-340)."""
+        n_dev = self._n_mesh_devices
+        out = {}
+        for k, v in b.items():
+            a = jnp.asarray(v)
+            if k != "_valid" and a.ndim >= 1 and a.shape[0] % n_dev == 0 and n_dev > 1:
+                out[k] = jax.device_put(a, self._batch_sharding)
+            else:
+                out[k] = jax.device_put(a, self._replicated)
+        return out
+
     def evaluate(self, batches: Iterable[Dict[str, np.ndarray]]) -> Dict[str, float]:
         totals: Dict[str, float] = {}
         n = 0.0
-        n_batches = 0
         for b in batches:
-            out = self.eval_fn(self.state.params_vec, jax.tree.map(jnp.asarray, b))
+            out = self.eval_fn(self.state.params_vec, self._put_eval_batch(b))
+            valid = float(b["_valid"])
             for k, v in out.items():
-                totals[k] = totals.get(k, 0.0) + float(v)
-            n += float(b["_valid"])
-            n_batches += 1
+                # loss_sum/correct/count are already per-row sums; weight any
+                # other (per-batch mean) aux key by the batch's valid rows so
+                # the padded tail batch doesn't bias the average (ADVICE r1).
+                w = 1.0 if k in ("loss_sum", "correct", "count") else valid
+                totals[k] = totals.get(k, 0.0) + w * float(v)
+            n += valid
         result = {"loss": totals.get("loss_sum", 0.0) / max(n, 1.0)}
         if "count" in totals and totals["count"] > 0:
             result["accuracy"] = totals.get("correct", 0.0) / totals["count"]
         for k, v in totals.items():
-            # loss_sum/correct/count are per-row sums normalized above; any
-            # other aux key is a per-batch mean, so average over batches.
             if k not in ("loss_sum", "correct", "count"):
-                result[k] = v / max(n_batches, 1)
+                result[k] = v / max(n, 1.0)
         return result
 
     # -- weights ----------------------------------------------------------
@@ -110,16 +160,33 @@ class FederatedSession:
 
     def bytes_per_round(self) -> Dict[str, int]:
         """Upload/download bytes per participating client (BASELINE.md
-        accounting) — the headline communication metric."""
+        accounting) — the headline communication metric. Sketch upload is the
+        REALIZED table size ``r * c_actual`` (the blocked layout rounds the
+        requested num_cols to bucket-block multiples), not the request
+        (ADVICE r1: the request can silently understate the payload)."""
         d, k = self.grad_size, self.cfg.k
-        up = {
-            "uncompressed": d,
-            "fedavg": d,
-            "true_topk": d,
-            "local_topk": 2 * k,
-            "sketch": self.cfg.num_rows * self.cfg.num_cols,
-        }[self.cfg.mode]
-        down = k if self.cfg.do_topk_down else d
+        if self.cfg.mode == "sketch":
+            r, c_actual = self.spec.table_shape
+            up = r * c_actual
+            requested = self.cfg.num_rows * self.cfg.num_cols
+            if up > 1.25 * requested:
+                import warnings
+
+                warnings.warn(
+                    f"realized sketch table ({up} floats) exceeds the "
+                    f"requested num_rows*num_cols ({requested}) by >25%: "
+                    "the blocked layout's per-chunk bucket floor inflated "
+                    "it — raise num_cols or chunk size m.",
+                    stacklevel=2,
+                )
+        else:
+            up = {
+                "uncompressed": d,
+                "fedavg": d,
+                "true_topk": d,
+                "local_topk": 2 * k,
+            }[self.cfg.mode]
+        down = 2 * k if self.cfg.do_topk_down else d
         return {"upload_floats": up, "download_floats": down,
                 "upload_bytes": 4 * up, "download_bytes": 4 * down}
 
@@ -129,12 +196,27 @@ class FedModel:
 
     def __init__(self, session: FederatedSession):
         self.session = session
+        self.optimizer: Optional["FedOptimizer"] = None  # set by make_fed_pair
 
-    def __call__(self, client_ids, batch, lr: float):
+    def __call__(self, client_ids, batch, lr: Optional[float] = None):
+        if lr is None:
+            if self.optimizer is None:
+                raise ValueError(
+                    "no lr given and no FedOptimizer attached; pass lr= or "
+                    "construct via make_fed_pair"
+                )
+            lr = self.optimizer.get_lr()
         return self.session.train_round(client_ids, batch, lr)
 
     def evaluate(self, batches):
         return self.session.evaluate(batches)
+
+    def save_pretrained(self, out_dir: str, gcfg) -> None:
+        """HF-format export passthrough for the GPT-2 workload
+        (``FedModel.save_pretrained``, fed_aggregator.py ~L260-280)."""
+        from commefficient_tpu.models.hf_gpt2 import save_pretrained
+
+        save_pretrained(out_dir, gcfg, self.session.params)
 
     @property
     def params(self):
@@ -163,4 +245,6 @@ class FedOptimizer:
 def make_fed_pair(cfg: Config, params, loss_fn, lr_fn, **kw):
     """Reference-style constructor: (FedModel, FedOptimizer) sharing a session."""
     session = FederatedSession(cfg, params, loss_fn, **kw)
-    return FedModel(session), FedOptimizer(session, lr_fn)
+    model, opt = FedModel(session), FedOptimizer(session, lr_fn)
+    model.optimizer = opt
+    return model, opt

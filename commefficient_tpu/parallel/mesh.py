@@ -24,6 +24,41 @@ MODEL = "model"
 SEQ = "seq"
 
 
+def initialize_distributed() -> bool:
+    """Multi-host bring-up (SURVEY.md §5 "Distributed communication
+    backend" rebuild column — a capability the reference never had).
+
+    Calls ``jax.distributed.initialize()`` when a coordinator is configured
+    via the standard env (``JAX_COORDINATOR_ADDRESS`` + ``JAX_NUM_PROCESSES``
+    + ``JAX_PROCESS_ID``, or a TPU pod runtime that auto-detects). After it,
+    ``jax.devices()`` spans all hosts and ``make_mesh`` over the global
+    device list gives psums that ride ICI within a slice and DCN across
+    slices. No-op (returns False) single-host, so entry points can call it
+    unconditionally.
+    """
+    import os
+
+    multi_host_signals = (
+        "JAX_COORDINATOR_ADDRESS",  # explicit jax.distributed coordinator
+        "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",  # multislice runtime
+        "TPU_WORKER_HOSTNAMES",  # Cloud TPU pod metadata (auto-detect path)
+    )
+    if not any(os.environ.get(k) for k in multi_host_signals):
+        return False  # single-host; don't touch the backend at all
+    # NB: must not call jax.process_count()/jax.devices() first — that would
+    # initialize the local backend and make distributed.initialize() raise.
+    try:  # private, but the only no-side-effect way to detect prior init
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            return True  # already initialized
+    except (ImportError, AttributeError):
+        pass
+    jax.distributed.initialize()
+    return True
+
+
 def make_mesh(
     num_workers_axis: int = 1,
     model: int = 1,
