@@ -7,7 +7,11 @@ Host-side numpy throughout (runs outside jit), mirroring the reference's
 
 from commefficient_tpu.data.fed_dataset import FedDataset
 from commefficient_tpu.data.sampler import FedSampler
-from commefficient_tpu.data.cifar import load_fed_cifar10, augment_batch
+from commefficient_tpu.data.cifar import (
+    load_fed_cifar10,
+    load_fed_cifar100,
+    augment_batch,
+)
 from commefficient_tpu.data.emnist import load_fed_emnist
 from commefficient_tpu.data.imagenet import load_fed_imagenet
 from commefficient_tpu.data.personachat import (
@@ -21,6 +25,7 @@ __all__ = [
     "FedDataset",
     "FedSampler",
     "load_fed_cifar10",
+    "load_fed_cifar100",
     "augment_batch",
     "load_fed_emnist",
     "load_fed_imagenet",

@@ -92,6 +92,20 @@ def augment_batch(batch: Dict[str, np.ndarray], rng: np.random.Generator) -> Dic
     return {**batch, "x": out}
 
 
+def _load_cifar100(root: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """The ``cifar-100-python`` pickle layout (train/test files, fine labels)."""
+    d = os.path.join(root, "cifar-100-python")
+
+    def read(fname):
+        with open(os.path.join(d, fname), "rb") as f:
+            raw = pickle.load(f, encoding="bytes")
+        x = raw[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        y = np.asarray(raw[b"fine_labels"], np.int32)
+        return {"x": x, "y": y}
+
+    return read("train"), read("test")
+
+
 def load_fed_cifar10(
     dataset_dir: str,
     *,
@@ -106,6 +120,27 @@ def load_fed_cifar10(
         train, test = _load_cifar10_batches(dataset_dir)
     else:
         train, test = _synthetic_cifar(num_classes)
+    train = {"x": normalize(train["x"]), "y": train["y"]}
+    test = {"x": normalize(test["x"]), "y": test["y"]}
+    tr = FedDataset(train, num_clients, iid=iid, seed=seed)
+    te = FedDataset(test, 1, iid=True, seed=seed)
+    return tr, te, real
+
+
+def load_fed_cifar100(
+    dataset_dir: str,
+    *,
+    num_clients: int,
+    iid: bool = True,
+    seed: int = 42,
+) -> Tuple[FedDataset, FedDataset, bool]:
+    """FedCIFAR100 (reference ``data_utils/fed_cifar.py`` ~L1-120): same
+    prep/augment as CIFAR-10, 100 fine labels."""
+    real = os.path.isdir(os.path.join(dataset_dir, "cifar-100-python"))
+    if real:
+        train, test = _load_cifar100(dataset_dir)
+    else:
+        train, test = _synthetic_cifar(100)
     train = {"x": normalize(train["x"]), "y": train["y"]}
     test = {"x": normalize(test["x"]), "y": test["y"]}
     tr = FedDataset(train, num_clients, iid=iid, seed=seed)

@@ -2,20 +2,82 @@
 
 Behavioral spec from the reference's ``data_utils/fed_imagenet.py`` ~L1-120
 (SURVEY.md §2): ImageFolder-style layout (``train/<wnid>/*.JPEG``), client
-sharding over classes. Real JPEG decoding would need PIL + the actual
-dataset; with zero egress we support (a) a preprocessed ``.npy`` cache
-(``imagenet_x.npy``/``imagenet_y.npy`` under ``dataset_dir/imagenet``) and
-(b) a synthetic stand-in at reduced resolution for pipeline/benchmark runs.
+sharding over classes. Three sources, in order of preference:
+
+  (a) a preprocessed ``.npy`` cache (``imagenet_x.npy``/``imagenet_y.npy``
+      under ``dataset_dir/imagenet``) — fastest, recommended for TPU runs;
+  (b) an ImageFolder tree (``dataset_dir/imagenet/train/<wnid>/*.JPEG``)
+      decoded with PIL if available (resized+center-cropped to ``size``,
+      then cached to (a) so decoding happens once);
+  (c) a synthetic stand-in at reduced resolution for pipeline/benchmark
+      runs with zero egress.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 from commefficient_tpu.data.fed_dataset import FedDataset
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _load_imagefolder(
+    train_root: str, size: int, max_per_class: Optional[int] = None
+) -> Optional[dict]:
+    """Decode an ImageFolder tree with PIL (None if PIL is unavailable).
+
+    Images are resized so the short side is ``size`` then center-cropped to
+    ``size x size`` — the reference's val-style deterministic transform (its
+    random-resized-crop augmentation is train-time policy, applied by the
+    sampler's augment hook, not baked into the cache). Returns UINT8 pixels
+    (normalization happens after load) so the .npy cache is 4x smaller, and
+    caps decoding at ``max_per_class`` so a full ImageNet tree cannot OOM
+    the host.
+    """
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    exts = (".jpeg", ".jpg", ".png")
+    wnids = sorted(
+        d for d in os.listdir(train_root)
+        if os.path.isdir(os.path.join(train_root, d))
+    )
+    xs, ys = [], []
+    for label, wnid in enumerate(wnids):
+        cdir = os.path.join(train_root, wnid)
+        files = sorted(
+            f for f in os.listdir(cdir) if f.lower().endswith(exts)
+        )[:max_per_class]
+        for fn in files:
+            with Image.open(os.path.join(cdir, fn)) as im:
+                im = im.convert("RGB")
+                w, h = im.size
+                scale = size / min(w, h)
+                im = im.resize((round(w * scale), round(h * scale)))
+                w, h = im.size
+                left, top = (w - size) // 2, (h - size) // 2
+                im = im.crop((left, top, left + size, top + size))
+                xs.append(np.asarray(im, np.uint8))
+            ys.append(label)
+    if not xs:
+        return None
+    return {"x": np.stack(xs), "y": np.asarray(ys, np.int32)}
+
+
+def _normalize_imagenet(x: np.ndarray) -> np.ndarray:
+    """uint8 HWC -> normalized float32; float inputs pass through (already-
+    normalized caches from npy drops)."""
+    if x.dtype != np.uint8:
+        return np.asarray(x, np.float32)
+    return ((x.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD).astype(
+        np.float32
+    )
 
 
 def _synthetic_imagenet(
@@ -36,15 +98,33 @@ def load_fed_imagenet(
     seed: int = 42,
     num_classes: int = 1000,
     synthetic_size: int = 64,
+    max_per_class: int = 300,
 ) -> Tuple[FedDataset, FedDataset, bool]:
     root = os.path.join(dataset_dir, "imagenet")
     xp, yp = os.path.join(root, "imagenet_x.npy"), os.path.join(root, "imagenet_y.npy")
     real = os.path.exists(xp) and os.path.exists(yp)
     if real:
-        data = {"x": np.load(xp), "y": np.load(yp)}
+        data = {"x": _normalize_imagenet(np.load(xp)), "y": np.load(yp)}
     else:
-        data = _synthetic_imagenet(num_classes, size=synthetic_size, seed=seed)
+        train_root = os.path.join(root, "train")
+        data = None
+        if os.path.isdir(train_root):
+            data = _load_imagefolder(
+                train_root, size=max(synthetic_size, 64),
+                max_per_class=max_per_class,
+            )
+            if data is not None:
+                real = True
+                np.save(xp, data["x"])  # uint8 cache: decode happens once
+                np.save(yp, data["y"])
+                data = {"x": _normalize_imagenet(data["x"]), "y": data["y"]}
+        if data is None:
+            data = _synthetic_imagenet(num_classes, size=synthetic_size, seed=seed)
     n = len(data["y"])
+    # the ImageFolder decode is class-sorted: shuffle (seeded) before the
+    # positional split so the test tail isn't just the last classes
+    perm = np.random.default_rng(seed).permutation(n)
+    data = {k: v[perm] for k, v in data.items()}
     cut = int(0.95 * n)
     train = FedDataset(
         {k: v[:cut] for k, v in data.items()}, num_clients, iid=iid, seed=seed

@@ -112,3 +112,65 @@ def test_personachat_assembly_contract(tmp_path):
             assert d["input_ids"][i, c, t] != pad
     # all ids within vocab
     assert d["input_ids"].max() < vocab
+
+
+def test_cifar100_loader_synthetic_fallback(tmp_path):
+    from commefficient_tpu.data import load_fed_cifar100
+
+    train, test, real = load_fed_cifar100(str(tmp_path), num_clients=10)
+    assert not real
+    assert train.data["y"].max() == 99 and train.data["y"].min() == 0
+    assert train.data["x"].shape[1:] == (32, 32, 3)
+    assert train.num_clients == 10
+
+
+def test_cifar100_loader_real_pickles(tmp_path):
+    """The cifar-100-python pickle layout is read when present."""
+    import pickle
+
+    import numpy as np
+
+    from commefficient_tpu.data import load_fed_cifar100
+
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in (("train", 40), ("test", 20)):
+        raw = {
+            b"data": rng.integers(0, 255, size=(n, 3072), dtype=np.uint8).astype(np.uint8),
+            b"fine_labels": rng.integers(0, 100, size=n).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(raw, f)
+    train, test, real = load_fed_cifar100(str(tmp_path), num_clients=4)
+    assert real
+    assert len(train) == 40 and len(test) == 20
+
+
+def test_imagenet_imagefolder_decode_and_cache(tmp_path):
+    """ImageFolder JPEG tree decodes via PIL and caches to .npy."""
+    import numpy as np
+    import pytest
+
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from commefficient_tpu.data import load_fed_imagenet
+
+    root = tmp_path / "imagenet" / "train"
+    rng = np.random.default_rng(0)
+    for wnid in ("n01440764", "n01443537"):
+        (root / wnid).mkdir(parents=True)
+        for i in range(3):
+            arr = rng.integers(0, 255, size=(80, 96, 3), dtype=np.uint8)
+            Image.fromarray(arr.astype(np.uint8)).save(root / wnid / f"{i}.JPEG")
+    train, test, real = load_fed_imagenet(
+        str(tmp_path), num_clients=2, iid=True, synthetic_size=64
+    )
+    assert real
+    assert train.data["x"].shape[1:] == (64, 64, 3)
+    assert set(np.unique(np.concatenate([train.data["y"], test.data["y"]]))) == {0, 1}
+    # the decode was cached for the next run
+    assert (tmp_path / "imagenet" / "imagenet_x.npy").exists()
+    train2, _, real2 = load_fed_imagenet(str(tmp_path), num_clients=2, iid=True)
+    assert real2 and len(train2) == len(train)
