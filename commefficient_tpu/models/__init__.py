@@ -11,7 +11,11 @@ observation that made the reference carry FixupResNet
 
 from commefficient_tpu.models.resnet9 import ResNet9
 from commefficient_tpu.models.fixup_resnet import FixupResNet, fixup_resnet50
-from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+from commefficient_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2DoubleHeads,
+    gpt2_tiny_config,
+)
 from commefficient_tpu.models.losses import (
     softmax_cross_entropy,
     classification_loss,
@@ -24,6 +28,7 @@ __all__ = [
     "fixup_resnet50",
     "GPT2Config",
     "GPT2DoubleHeads",
+    "gpt2_tiny_config",
     "softmax_cross_entropy",
     "classification_loss",
     "gpt2_double_heads_loss",

@@ -9,8 +9,11 @@ default, D ~= 124M), not a port of HF code:
 
 * bf16 activations / fp32 params; attention scores accumulated in fp32.
 * a pluggable ``attn_fn`` hook: the default is dense causal attention; the
-  sequence-parallel path swaps in ring attention
-  (``commefficient_tpu.parallel.ring_attention``) without touching the model.
+  sequence-parallel path swaps in
+  ``commefficient_tpu.parallel.ring_attention.ring_attention`` (run the model
+  under shard_map with T sharded on the ``seq`` axis and pass each block's
+  global ``positions``; see ``parallel/sequence.py``) without touching the
+  model body.
 * weight tying between token embedding and LM head (as in GPT-2).
 * HF-compatible config field names so checkpoints can be mapped over if
   GPT-2 weights are available on disk.
@@ -100,13 +103,16 @@ class GPT2Backbone(nn.Module):
     attn_fn: Callable = staticmethod(dense_causal_attention)
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None):
+    def __call__(self, input_ids, token_type_ids=None, positions=None):
         c = self.cfg
         init = nn.initializers.normal(c.initializer_range)
         wte = self.param("wte", init, (c.vocab_size, c.n_embd), jnp.float32)
         wpe = self.param("wpe", init, (c.n_positions, c.n_embd), jnp.float32)
         T = input_ids.shape[-1]
-        h = wte[input_ids] + wpe[jnp.arange(T)]
+        if positions is None:
+            positions = jnp.arange(T)  # sequence-sharded callers pass the
+            # global positions of their local block (parallel/sequence.py)
+        h = wte[input_ids] + wpe[positions]
         if token_type_ids is not None:
             # HF GPT-2 embeds token types through the token table.
             h = h + wte[token_type_ids]

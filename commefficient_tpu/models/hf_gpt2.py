@@ -1,0 +1,112 @@
+"""HF GPT-2 checkpoint interop: load torch weights into our flax GPT-2.
+
+The reference starts from HuggingFace's pretrained ``GPT2DoubleHeadsModel``
+(``gpt2_train.py`` ~L140-200, flag ``--model_checkpoint``) and resizes the
+embedding for the 5 PersonaChat special tokens. Zero-egress environments
+can't download weights, so this module is a *mapper*, not a fetcher: if a
+local checkpoint directory (or cached HF snapshot) holds a
+``pytorch_model.bin``, its tensors are mapped into our parameter tree;
+otherwise callers fall back to fresh init.
+
+Name mapping (ours <- HF torch GPT2):
+  transformer/wte            <- transformer.wte.weight        [V, E]
+  transformer/wpe            <- transformer.wpe.weight        [P, E]
+  transformer/h_i/ln_1,ln_2  <- ...ln_1.weight/.bias          (scale/bias)
+  transformer/h_i/attn/c_attn, c_proj, mlp/c_fc, mlp/c_proj
+                             <- HF Conv1D .weight [in, out]   == Dense kernel
+  transformer/ln_f           <- transformer.ln_f.weight/.bias
+LM head is tied to wte (both sides); the MC head has no pretrained analog
+and keeps its fresh init.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def find_torch_checkpoint(model_checkpoint: str) -> Optional[str]:
+    """Path to a local pytorch_model.bin for ``model_checkpoint``, if any."""
+    cands = [model_checkpoint]
+    hub = os.path.expanduser("~/.cache/huggingface/hub")
+    if os.path.isdir(hub):
+        for snap_root in sorted(
+            os.path.join(hub, d, "snapshots")
+            for d in os.listdir(hub)
+            if d.endswith(model_checkpoint.replace("/", "--"))
+        ):
+            if os.path.isdir(snap_root):
+                cands += [os.path.join(snap_root, s) for s in os.listdir(snap_root)]
+    for c in cands:
+        p = os.path.join(c, "pytorch_model.bin")
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def load_hf_gpt2_params(
+    checkpoint: str, gcfg, params: Any, *, seed: int = 0
+) -> tuple[Any, bool]:
+    """Map a local HF GPT-2 torch checkpoint into ``params`` (our tree).
+
+    Returns (params, loaded). Embedding rows beyond the HF vocab (the
+    special tokens) keep their fresh init — the reference's
+    ``resize_token_embeddings`` + random-new-rows behavior.
+    """
+    path = find_torch_checkpoint(checkpoint)
+    if path is None:
+        return params, False
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    sd = {k.removeprefix("transformer."): v for k, v in sd.items()}
+    t = lambda k: jnp.asarray(np.asarray(sd[k], np.float32))
+
+    p = jax.tree.map(lambda x: x, params)  # shallow copy of the dict tree
+    tr = p["params"]["transformer"]
+
+    def resize_rows(ours: jnp.ndarray, theirs: jnp.ndarray) -> jnp.ndarray:
+        n = min(ours.shape[0], theirs.shape[0])
+        return ours.at[:n].set(theirs[:n].astype(ours.dtype))
+
+    tr["wte"] = resize_rows(tr["wte"], t("wte.weight"))
+    tr["wpe"] = resize_rows(tr["wpe"], t("wpe.weight"))
+    for i in range(gcfg.n_layer):
+        b, hf = tr[f"h_{i}"], f"h.{i}."
+        for ln in ("ln_1", "ln_2"):
+            b[ln]["scale"] = t(hf + ln + ".weight")
+            b[ln]["bias"] = t(hf + ln + ".bias")
+        b["attn"]["c_attn"]["kernel"] = t(hf + "attn.c_attn.weight")
+        b["attn"]["c_attn"]["bias"] = t(hf + "attn.c_attn.bias")
+        b["attn"]["c_proj"]["kernel"] = t(hf + "attn.c_proj.weight")
+        b["attn"]["c_proj"]["bias"] = t(hf + "attn.c_proj.bias")
+        b["mlp"]["c_fc"]["kernel"] = t(hf + "mlp.c_fc.weight")
+        b["mlp"]["c_fc"]["bias"] = t(hf + "mlp.c_fc.bias")
+        b["mlp"]["c_proj"]["kernel"] = t(hf + "mlp.c_proj.weight")
+        b["mlp"]["c_proj"]["bias"] = t(hf + "mlp.c_proj.bias")
+    tr["ln_f"]["scale"] = t("ln_f.weight")
+    tr["ln_f"]["bias"] = t("ln_f.bias")
+    return p, True
+
+
+def save_pretrained(out_dir: str, gcfg, params: Any) -> None:
+    """HF-style checkpoint directory: config.json + flax_model.msgpack
+    (``FedModel.save_pretrained`` analog, fed_aggregator.py ~L260-280)."""
+    import dataclasses
+    import json
+
+    from flax import serialization
+
+    os.makedirs(out_dir, exist_ok=True)
+    cfg_dict = {
+        k: v for k, v in dataclasses.asdict(gcfg).items() if k != "dtype"
+    }
+    cfg_dict["model_type"] = "gpt2"
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg_dict, f, indent=2)
+    with open(os.path.join(out_dir, "flax_model.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(params))

@@ -1,0 +1,247 @@
+"""gpt2_train — the NLP workload entry point (BASELINE config #4).
+
+Reference: ``CommEfficient/gpt2_train.py`` ~L140-360 (SURVEY.md §2
+"gpt2_train entry", §3.2): PersonaChat build + tokenize, special-token
+vocab resize, federated training of ``GPT2DoubleHeadsModel`` with the twin
+``lm_coef*CE_lm + mc_coef*CE_mc`` loss, eval reporting nll -> perplexity and
+multiple-choice accuracy, and ``save_pretrained`` HF-format checkpointing.
+
+Run-command parity examples:
+
+  python -m commefficient_tpu.train.gpt2_train --mode sketch --k 50000 \
+      --num_rows 5 --num_cols 1250000 --virtual_momentum 0.9 \
+      --error_type virtual --num_workers 8 --num_devices 8   # BASELINE #4
+  python -m commefficient_tpu.train.gpt2_train --model gpt2_tiny \
+      --num_epochs 2 --num_workers 2 --num_devices 1         # CPU smoke
+
+At GPT-2 scale (D ~= 124M) use ``--offload_client_state true`` for
+local-error/local-momentum configs — per-client state stays in host RAM
+(SURVEY.md §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from commefficient_tpu.data import FedSampler, load_fed_personachat
+from commefficient_tpu.models import (
+    GPT2Config,
+    GPT2DoubleHeads,
+    gpt2_double_heads_loss,
+    gpt2_tiny_config,
+)
+from commefficient_tpu.models.hf_gpt2 import load_hf_gpt2_params, save_pretrained
+from commefficient_tpu.parallel import FederatedSession, mask_gpt2
+from commefficient_tpu.utils import (
+    Config,
+    MetricsWriter,
+    TableLogger,
+    Timer,
+    parse_args,
+    piecewise_linear_lr,
+)
+from commefficient_tpu.utils.logging import make_logdir
+
+
+def build_model_and_data(cfg: Config):
+    """PersonaChat + GPT-2 with the special-token vocab resize."""
+    # gpt2 (small, paper scale) keeps the real GPT-2 vocab even on synthetic
+    # data so D ~= 124M; gpt2_tiny is the CPU-testable config.
+    base_vocab = 50257 if cfg.model == "gpt2" else 512
+    train, test, real, vocab = load_fed_personachat(
+        cfg.dataset_dir,
+        num_clients=cfg.num_clients,
+        num_candidates=cfg.num_candidates,
+        max_history=cfg.max_history,
+        max_seq_len=cfg.max_seq_len,
+        base_vocab=base_vocab,
+        seed=cfg.seed,
+    )
+    if cfg.model == "gpt2":
+        gcfg = GPT2Config(vocab_size=vocab, n_positions=max(1024, cfg.max_seq_len))
+    elif cfg.model == "gpt2_tiny":
+        tiny = gpt2_tiny_config()
+        gcfg = GPT2Config(
+            vocab_size=vocab,
+            n_positions=max(tiny.n_positions, cfg.max_seq_len),
+            n_embd=tiny.n_embd,
+            n_layer=tiny.n_layer,
+            n_head=tiny.n_head,
+        )
+    else:
+        raise ValueError(f"unknown gpt2 model {cfg.model!r} (gpt2 | gpt2_tiny)")
+    model = GPT2DoubleHeads(gcfg)
+    sample = {
+        "input_ids": jnp.zeros((1, cfg.num_candidates, cfg.max_seq_len), jnp.int32),
+        "token_type_ids": jnp.zeros((1, cfg.num_candidates, cfg.max_seq_len), jnp.int32),
+        "mc_token_ids": jnp.zeros((1, cfg.num_candidates), jnp.int32),
+    }
+    params = model.init(
+        jax.random.key(cfg.seed),
+        sample["input_ids"],
+        token_type_ids=sample["token_type_ids"],
+        mc_token_ids=sample["mc_token_ids"],
+    )
+    params, loaded = load_hf_gpt2_params(cfg.model_checkpoint, gcfg, params, seed=cfg.seed)
+    loss_fn = gpt2_double_heads_loss(model.apply, cfg.lm_coef, cfg.mc_coef)
+    return train, test, real, loaded, gcfg, model, params, loss_fn
+
+
+def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
+               test_ds, writer: Optional[MetricsWriter] = None,
+               table: Optional[TableLogger] = None, eval_batch_size: int = 8,
+               checkpointer=None):
+    """Epoch loop with the reference's eval: nll -> ppl + MC accuracy
+    (gpt2_train.py ~L280-360). Honors checkpoint_every/resume like
+    cv_train.train_loop."""
+    steps_per_epoch = sampler.steps_per_epoch()
+    lr_fn = partial(
+        piecewise_linear_lr,
+        steps_per_epoch=steps_per_epoch,
+        pivot_epoch=cfg.pivot_epoch,
+        num_epochs=cfg.num_epochs,
+        lr_scale=cfg.lr_scale,
+    )
+    table = table or TableLogger()
+    timer = Timer()
+    val = {}
+    step = 0
+    W = cfg.num_workers
+    if checkpointer is not None and cfg.resume:
+        restored = checkpointer.restore(session)
+        if restored is not None:
+            step = restored
+            print(f"resumed from checkpoint at round {step}")
+    for epoch in range(step // steps_per_epoch, cfg.num_epochs):
+        timer()
+        tr_loss = tr_lm = tr_mc = 0.0
+        for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
+            if epoch * steps_per_epoch + round_idx < step:
+                continue  # fast-forward within the resumed epoch
+            if cfg.mode == "fedavg":
+                L = cfg.num_local_iters
+                batch = {
+                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                    for k, v in batch.items()
+                }
+            lr = float(lr_fn(step))
+            metrics = session.train_round(client_ids, batch, lr)
+            tr_loss += float(metrics["loss"])
+            # lm/mc aux are psum'd sums of per-client means -> divide by W
+            tr_lm += float(metrics.get("lm_loss", 0.0)) / W
+            tr_mc += float(metrics.get("mc_loss", 0.0)) / W
+            if writer:
+                writer.scalar("train/loss", float(metrics["loss"]), step)
+                writer.scalar("lr", lr, step)
+            step += 1
+            if checkpointer is not None:
+                checkpointer.maybe_save(session, step)
+        train_time = timer()
+        val = evaluate_ppl(session, test_ds, eval_batch_size)
+        val_time = timer()
+        row = {
+            "epoch": epoch + 1,
+            "lr": lr,
+            "train_loss": tr_loss / steps_per_epoch,
+            "train_lm": tr_lm / steps_per_epoch,
+            "train_mc": tr_mc / steps_per_epoch,
+            "val_nll": val["nll"],
+            "val_ppl": val["ppl"],
+            "val_mc_acc": val["mc_accuracy"],
+            "train_time": train_time,
+            "val_time": val_time,
+        }
+        table.append(row)
+        if writer:
+            writer.scalar("val/nll", val["nll"], step)
+            writer.scalar("val/ppl", val["ppl"], step)
+            writer.scalar("val/mc_acc", val["mc_accuracy"], step)
+            writer.flush()
+    return val
+
+
+def evaluate_ppl(session: FederatedSession, test_ds, batch_size: int):
+    """nll (masked-token mean LM loss) -> ppl, plus MC accuracy — the
+    reference's eval metrics (gpt2_train.py ~L280-360)."""
+    out = session.evaluate(test_ds.eval_batches(batch_size))
+    nll = out.get("lm_loss", out["loss"])
+    return {
+        "nll": nll,
+        "ppl": float(np.exp(min(nll, 20.0))),
+        "mc_accuracy": out.get("accuracy", float("nan")),
+        "loss": out["loss"],
+    }
+
+
+def main(argv=None, **overrides):
+    from commefficient_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()  # no-op single-host
+    cfg = parse_args(
+        argv,
+        defaults=dict(
+            model="gpt2",
+            dataset_name="personachat",
+            local_batch_size=4,
+            lr_scale=0.16,  # reference gpt2 lr territory (paper appendix)
+            max_grad_norm=1.0,
+        ),
+        **overrides,
+    )
+    train, test, real, hf_loaded, gcfg, model, params, loss_fn = (
+        build_model_and_data(cfg)
+    )
+    print(
+        f"dataset=personachat (real={real}) model={cfg.model} "
+        f"(V={gcfg.vocab_size}, L={gcfg.n_layer}, E={gcfg.n_embd}, "
+        f"hf_weights={hf_loaded}) mode={cfg.mode} "
+        f"clients={train.num_clients} workers={cfg.num_workers}"
+    )
+    if not real:
+        print("WARNING: personachat json not found — synthetic stand-in "
+              "(pipeline-correct; metrics are not paper numbers)")
+    session = FederatedSession(cfg, params, loss_fn, mask_batch=mask_gpt2)
+    bpr = session.bytes_per_round()
+    print(f"grad_size D={session.grad_size}  upload/client/round="
+          f"{bpr['upload_bytes']:,} B  download={bpr['download_bytes']:,} B")
+    sampler = FedSampler(
+        train,
+        num_workers=cfg.num_workers,
+        local_batch_size=cfg.local_batch_size
+        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
+        seed=cfg.seed,
+    )
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
+    from commefficient_tpu.utils.checkpoint import FedCheckpointer
+
+    # full-state checkpoints go under <checkpoint_dir>/state; the HF-format
+    # save_pretrained export (below) stays at the top level.
+    checkpointer = FedCheckpointer(
+        cfg.replace(checkpoint_dir=os.path.join(cfg.checkpoint_dir, "state"))
+        if cfg.checkpoint_dir
+        else cfg
+    )
+    try:
+        val = train_loop(cfg, session, sampler, test, writer,
+                         checkpointer=checkpointer)
+        if checkpointer.enabled:
+            checkpointer.maybe_save(session, int(session.state.step), force=True)
+    finally:
+        checkpointer.close()
+        writer.close()
+    print(f"final: val_nll={val['nll']:.4f} ppl={val['ppl']:.2f} "
+          f"mc_acc={val['mc_accuracy']:.4f}")
+    if cfg.checkpoint_dir:
+        save_pretrained(cfg.checkpoint_dir, gcfg, session.params)
+        print(f"saved HF-format checkpoint to {cfg.checkpoint_dir}")
+    return val
+
+
+if __name__ == "__main__":
+    main()

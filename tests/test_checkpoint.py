@@ -1,0 +1,100 @@
+"""Checkpoint/resume: kill-mid-training and resume must reproduce the
+uninterrupted run bit-for-bit (VERDICT r1 item 4 'done' criterion)."""
+
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import FedSampler
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils.checkpoint import FedCheckpointer
+from commefficient_tpu.utils.config import Config
+
+from tests.test_round import BASE, _setup
+
+
+def _train(sess, sampler, cfg, start, stop, ckpt=None):
+    for r in range(start, stop):
+        ids, batch = sampler.sample_round(r)
+        sess.train_round(ids, batch, lr=0.1 + 0.02 * r)  # varying lr
+        if ckpt is not None:
+            ckpt.maybe_save(sess, r + 1)
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("sketch", dict(error_type="virtual", virtual_momentum=0.9, k=40,
+                    num_rows=3, num_cols=512)),
+    ("local_topk", dict(error_type="local", local_momentum=0.9, k=30)),
+    ("local_topk", dict(error_type="local", k=30, offload_client_state=True)),
+])
+def test_kill_and_resume_reproduces_uninterrupted_run(tmp_path, mode, extra):
+    cfg = Config(mode=mode, **extra, **BASE)
+
+    # uninterrupted: 8 rounds
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess_a = FederatedSession(cfg, params, loss_fn)
+    samp = FedSampler(ds, num_workers=cfg.num_workers,
+                      local_batch_size=cfg.local_batch_size, seed=1)
+    _train(sess_a, samp, cfg, 0, 8)
+
+    # interrupted: 4 rounds, checkpoint, fresh process state, restore, 4 more
+    ck_cfg = cfg.replace(checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=4)
+    ds2, params2, loss_fn2 = _setup(cfg.num_clients)
+    sess_b = FederatedSession(ck_cfg, params2, loss_fn2)
+    ckpt = FedCheckpointer(ck_cfg)
+    _train(sess_b, samp, ck_cfg, 0, 4, ckpt)
+    ckpt.close()
+
+    sess_c = FederatedSession(ck_cfg, params2, loss_fn2)  # fresh state
+    ckpt2 = FedCheckpointer(ck_cfg)
+    resumed = ckpt2.restore(sess_c)
+    assert resumed == 4
+    _train(sess_c, samp, ck_cfg, 4, 8)
+    ckpt2.close()
+
+    np.testing.assert_array_equal(
+        np.asarray(sess_a.state.params_vec), np.asarray(sess_c.state.params_vec)
+    )
+    if mode == "local_topk" and not extra.get("offload_client_state"):
+        np.testing.assert_array_equal(
+            np.asarray(sess_a.state.client_err), np.asarray(sess_c.state.client_err)
+        )
+    if extra.get("offload_client_state"):
+        np.testing.assert_array_equal(sess_a.host_err, sess_c.host_err)
+
+
+def test_checkpointer_disabled_without_dir():
+    cfg = Config(mode="uncompressed", **BASE)
+    ck = FedCheckpointer(cfg)
+    assert not ck.enabled
+    assert ck.restore(None) is None
+    assert not ck.maybe_save(None, 10)
+
+
+def test_restore_rejects_mismatched_model(tmp_path):
+    cfg = Config(mode="uncompressed", checkpoint_dir=str(tmp_path / "ck"),
+                 checkpoint_every=1, **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    samp = FedSampler(ds, num_workers=cfg.num_workers,
+                      local_batch_size=cfg.local_batch_size, seed=1)
+    ck = FedCheckpointer(cfg)
+    _train(sess, samp, cfg, 0, 1, ck)
+    ck.close()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    class Other(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    other = Other()
+    oparams = other.init(jax.random.key(0), jnp.zeros((1, 8)))
+    from commefficient_tpu.models.losses import classification_loss
+    sess2 = FederatedSession(cfg, oparams, classification_loss(other.apply))
+    ck2 = FedCheckpointer(cfg)
+    with pytest.raises(ValueError, match="grad_size"):
+        ck2.restore(sess2)
+    ck2.close()
