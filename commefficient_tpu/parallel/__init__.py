@@ -15,6 +15,11 @@ from commefficient_tpu.parallel.api import (
     FedOptimizer,
     make_fed_pair,
 )
+from commefficient_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+)
+from commefficient_tpu.parallel.sequence import sp_gpt2_apply
 
 __all__ = [
     "make_mesh",
@@ -31,4 +36,7 @@ __all__ = [
     "FedModel",
     "FedOptimizer",
     "make_fed_pair",
+    "ring_attention",
+    "ring_attention_sharded",
+    "sp_gpt2_apply",
 ]
