@@ -30,6 +30,7 @@ from commefficient_tpu.data import (
     FedSampler,
     augment_batch,
     load_fed_cifar10,
+    load_fed_cifar100,
     load_fed_emnist,
     load_fed_imagenet,
 )
@@ -53,7 +54,14 @@ def build_model_and_data(cfg: Config):
             cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
         )
         sample_shape = (1, 32, 32, 3)
-        num_classes = cfg.num_classes
+        num_classes = cfg.resolved_num_classes
+        augment = augment_batch
+    elif cfg.dataset_name == "cifar100":
+        train, test, real = load_fed_cifar100(
+            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
+        )
+        sample_shape = (1, 32, 32, 3)
+        num_classes = cfg.resolved_num_classes
         augment = augment_batch
     elif cfg.dataset_name == "femnist":
         train, test, real = load_fed_emnist(
@@ -67,7 +75,7 @@ def build_model_and_data(cfg: Config):
             cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
         )
         sample_shape = (1,) + train.data["x"].shape[1:]
-        num_classes = cfg.num_classes
+        num_classes = cfg.resolved_num_classes
         augment = None
     else:
         raise ValueError(f"unknown dataset {cfg.dataset_name!r}")
@@ -85,8 +93,14 @@ def build_model_and_data(cfg: Config):
 
 def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                test_ds, writer: Optional[MetricsWriter] = None,
-               table: Optional[TableLogger] = None, eval_batch_size: int = 512):
-    """The epoch loop (cv_train.py ~L120-240). Returns final val metrics."""
+               table: Optional[TableLogger] = None, eval_batch_size: int = 512,
+               checkpointer=None):
+    """The epoch loop (cv_train.py ~L120-240). Returns final val metrics.
+
+    With ``checkpointer`` (utils.checkpoint.FedCheckpointer) the loop honors
+    ``cfg.checkpoint_every``/``cfg.resume``: a resumed run fast-forwards to
+    the checkpointed round (sampler + lr schedule are pure functions of the
+    step, so this reproduces the uninterrupted run exactly)."""
     steps_per_epoch = sampler.steps_per_epoch()
     lr_fn = partial(
         piecewise_linear_lr,
@@ -97,12 +111,22 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     table = table or TableLogger()
     timer = Timer()
+    from commefficient_tpu.utils.profiling import StepProfiler
+
+    profiler = StepProfiler(cfg.profile_dir)
     val = {}
     step = 0
-    for epoch in range(cfg.num_epochs):
+    if checkpointer is not None and cfg.resume:
+        restored = checkpointer.restore(session)
+        if restored is not None:
+            step = restored
+            print(f"resumed from checkpoint at round {step}")
+    for epoch in range(step // steps_per_epoch, cfg.num_epochs):
         timer()
         train_loss, train_correct, train_count = 0.0, 0.0, 0.0
-        for client_ids, batch in sampler.epoch(epoch):
+        for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
+            if epoch * steps_per_epoch + round_idx < step:
+                continue  # fast-forward within the resumed epoch
             if cfg.mode == "fedavg":
                 L = cfg.num_local_iters
                 batch = {
@@ -110,6 +134,7 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                     for k, v in batch.items()
                 }
             lr = float(lr_fn(step))
+            profiler.step(step)
             metrics = session.train_round(client_ids, batch, lr)
             train_loss += float(metrics["loss"])
             train_correct += float(metrics.get("correct", 0.0))
@@ -118,6 +143,8 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 writer.scalar("train/loss", float(metrics["loss"]), step)
                 writer.scalar("lr", lr, step)
             step += 1
+            if checkpointer is not None:
+                checkpointer.maybe_save(session, step)
         train_time = timer()
         val = session.evaluate(test_ds.eval_batches(eval_batch_size))
         val_time = timer()
@@ -136,10 +163,14 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             writer.scalar("val/loss", val["loss"], step)
             writer.scalar("val/acc", val.get("accuracy", 0.0), step)
             writer.flush()
+    profiler.close()
     return val
 
 
 def main(argv=None, **overrides):
+    from commefficient_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()  # no-op single-host
     cfg = parse_args(argv, **overrides)
     train, test, real, model, params, loss_fn, augment = build_model_and_data(cfg)
     print(
@@ -163,9 +194,18 @@ def main(argv=None, **overrides):
         augment=augment,
     )
     writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
+    from commefficient_tpu.utils.checkpoint import FedCheckpointer
+
+    checkpointer = FedCheckpointer(cfg)
     try:
-        val = train_loop(cfg, session, sampler, test, writer)
+        val = train_loop(cfg, session, sampler, test, writer,
+                         checkpointer=checkpointer)
+        if checkpointer.enabled:
+            checkpointer.maybe_save(
+                session, int(session.state.step), force=True
+            )
     finally:
+        checkpointer.close()
         writer.close()
     print(f"final: val_loss={val['loss']:.4f} val_acc={val.get('accuracy', 0):.4f}")
     return val

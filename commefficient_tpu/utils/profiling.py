@@ -1,0 +1,41 @@
+"""Profiling hooks — ``jax.profiler`` traces around a window of rounds.
+
+The reference's only tracing is a console Timer around epoch phases
+(SURVEY.md §5 "Tracing/profiling"); the rebuild equivalent is a real XLA
+trace viewable in TensorBoard/Perfetto. ``StepProfiler`` wraps a few
+steady-state rounds (after compile/warmup) so the trace shows the real hot
+path, not compilation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class StepProfiler:
+    """Trace rounds [start_step, start_step + num_steps) into ``logdir``.
+
+    Call ``step(i)`` once per training round; call ``close()`` in a finally
+    block. Inactive (zero overhead) when ``logdir`` is falsy.
+    """
+
+    def __init__(self, logdir: str, start_step: int = 5, num_steps: int = 3):
+        self.logdir = logdir
+        self.start = start_step
+        self.stop_at = start_step + num_steps
+        self._active = False
+
+    def step(self, step_idx: int) -> None:
+        if not self.logdir:
+            return
+        if step_idx == self.start and not self._active:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif step_idx >= self.stop_at and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
