@@ -9,6 +9,15 @@ real CIFAR-shaped data. ``vs_baseline`` normalizes against an A100-class
 reference throughput for ResNet-9 federated training (the reference
 publishes no tables — BASELINE.json ``published: {}`` — so the denominator
 is the documented estimate below, not a measured upstream number).
+
+r2 changes: the round uses the TPU fast paths — matmul CountSketch
+(ops/countsketch.py v2: offset-keyed hashing -> one [m,s] one-hot operand,
+pure MXU), threshold top-k selection (ops/topk.py: no sort, no scatter),
+and the fused flattened-batch gradient (round.py fuse_clients, numerically
+identical here — pinned by tests). Methodology is the same python-loop
+dispatch as r1 with one scalar-fetch fence at the end (steady-state
+pipelined dispatch); a lax.scan-of-rounds variant was measured ~50x slower
+through the axon tunnel runtime (scripts/profile_scan.py) and is NOT used.
 """
 
 from __future__ import annotations
@@ -33,7 +42,9 @@ def main():
     from commefficient_tpu.parallel import FederatedSession, make_mesh
     from commefficient_tpu.utils.config import Config
 
-    workers, batch = 8, 64
+    # 8 virtual workers x 256-sample local batches (FetchSGD's CIFAR configs
+    # run local batches up to 500/client, paper §5) = 2048 samples/round.
+    workers, batch = 8, 256
     cfg = Config(
         mode="sketch",
         error_type="virtual",
@@ -42,6 +53,8 @@ def main():
         num_rows=5,
         num_cols=500_000,
         num_blocks=4,
+        topk_method="threshold",
+        fuse_clients=True,
         num_clients=2 * workers,
         num_workers=workers,
         num_devices=1,

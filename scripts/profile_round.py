@@ -1,0 +1,159 @@
+"""Stage-level timing of the bench round on the real chip (VERDICT r1 item 2).
+
+Times each stage of the federated sketch round separately with scalar-fetch
+fences (block_until_ready is unreliable through the axon tunnel), so the
+perf work attacks measured hot spots instead of guesses. Run WITHOUT the
+test conftest so it dials the real TPU:
+
+    python scripts/profile_round.py [--dtype bfloat16] [--reps 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fence(x):
+    jax.tree.leaves(x)[0].block_until_ready()
+    # scalar fetch — the only trustworthy fence through the tunnel
+    return float(jnp.sum(jax.tree.leaves(x)[0].ravel()[:1]))
+
+
+def timeit(name, fn, *args, reps=10):
+    fence(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    fence(out)
+    dt = (time.perf_counter() - t0) / reps * 1e3
+    print(f"{name:42s} {dt:8.2f} ms")
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--reps", type=int, default=10)
+    args = ap.parse_args()
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.ops import ravel_params
+    from commefficient_tpu.ops.countsketch import (
+        CountSketch, estimate_all, sketch_sparse, sketch_vec, unsketch_sparse,
+    )
+
+    print(f"devices: {jax.devices()}")
+    workers, batch = 8, 64
+    model = ResNet9(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply)
+    vec, unravel = ravel_params(params)
+    d = int(vec.size)
+    print(f"D = {d}")
+    spec = CountSketch(
+        d=d, c=500_000, r=5, seed=42,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    print(f"table: {spec.table_shape} (c_actual={spec.c_actual}, s={spec.s}, nc={spec.nc})")
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(workers * batch, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(workers * batch,)).astype(np.int32))
+    v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    k = 50_000
+    idx = jnp.asarray(rng.choice(d, size=k, replace=False).astype(np.int32))
+    vals = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
+
+    @jax.jit
+    def fwd_bwd(pv, x, y):
+        p = unravel(pv)
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, {"x": x, "y": y})
+        g, _ = jax.flatten_util.ravel_pytree(grads)
+        return g
+
+    @jax.jit
+    def per_worker_fwd_bwd(pv, x, y):
+        # the actual bench shape: vmap over 8 workers of batch-64 grads
+        xs = x.reshape(workers, batch, 32, 32, 3)
+        ys = y.reshape(workers, batch)
+        gs = jax.vmap(lambda xx, yy: fwd_bwd(pv, xx, yy))(xs, ys)
+        return jnp.sum(gs, 0)
+
+    from commefficient_tpu.ops.countsketch import unsketch_dense
+    from commefficient_tpu.ops.topk import topk_threshold_dense
+
+    sketch_j = jax.jit(lambda v: sketch_vec(spec, v))
+    est_j = jax.jit(lambda t: estimate_all(spec, t))
+    topk_j = jax.jit(lambda e: jax.lax.top_k(jnp.abs(e), k)[1])
+    approx_j = jax.jit(lambda e: jax.lax.approx_max_k(jnp.abs(e), k)[1])
+    thr_j = jax.jit(lambda e: topk_threshold_dense(e, k))
+    ssp_j = jax.jit(lambda i, va: sketch_sparse(spec, i, va))
+    unsk_j = jax.jit(lambda t: unsketch_sparse(spec, t, k))
+    unskd_j = jax.jit(lambda t: unsketch_dense(spec, t, k))
+    scatter_j = jax.jit(lambda i, va: jnp.zeros(d, jnp.float32).at[i].set(va))
+
+    table = sketch_j(v)
+    est = est_j(table)
+
+    r = args.reps
+    t_model = timeit("fwd+bwd batch 512 (monolithic)", fwd_bwd, vec, x, y, reps=r)
+    t_modelw = timeit("fwd+bwd 8x64 (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
+    t_sk = timeit("sketch_vec (dense d)", sketch_j, v, reps=r)
+    t_est = timeit("estimate_all", est_j, table, reps=r)
+    timeit("lax.top_k k=50k over d", topk_j, est, reps=r)
+    timeit("approx_max_k k=50k over d", approx_j, est, reps=r)
+    t_thr = timeit("topk_threshold_dense k=50k", thr_j, est, reps=r)
+    timeit("sketch_sparse k=50k (scatter)", ssp_j, idx, vals, reps=r)
+    timeit("unsketch_sparse (est+top_k)", unsk_j, table, reps=r)
+    t_unskd = timeit("unsketch_dense (est+threshold)", unskd_j, table, reps=r)
+    timeit("dense scatter of k", scatter_j, idx, vals, reps=r)
+
+    total = t_modelw + t_sk + t_unskd + t_sk
+    print(f"\nround ≈ model {t_modelw:.1f} + sketch {t_sk:.1f} + "
+          f"unsketch_dense {t_unskd:.1f} + resketch {t_sk:.1f} = {total:.1f} ms")
+    print(f"-> {workers * batch / total * 1e3:,.0f} samples/s (bench does 512/round)")
+
+    # ground truth: the bench's scanned round (no dispatch overhead)
+    from commefficient_tpu.models import classification_loss as _cl
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.config import Config
+
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=k, num_rows=5, num_cols=500_000, topk_method="threshold",
+                 num_clients=2 * workers, num_workers=workers, num_devices=1,
+                 local_batch_size=batch, weight_decay=5e-4)
+    session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+    ids = jnp.arange(workers, dtype=jnp.int32)
+    data = {"x": x.reshape(workers, batch, 32, 32, 3),
+            "y": y.reshape(workers, batch)}
+    round_fn = session.round_fn
+    n = 10
+
+    @jax.jit
+    def run_rounds(state):
+        def body(s, _):
+            s2, m = round_fn(s, ids, data, jnp.float32(0.1))
+            return s2, m["loss"]
+        return jax.lax.scan(body, state, None, length=n)
+
+    state, losses = run_rounds(session.state)
+    fence(losses)
+    t0 = time.perf_counter()
+    state, losses = run_rounds(state)
+    fence(losses)
+    dt = (time.perf_counter() - t0) / n * 1e3
+    print(f"scanned full round: {dt:.2f} ms -> "
+          f"{workers * batch / dt * 1e3:,.0f} samples/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Scan-based stage profiler: true device time per stage, one fence total.
+
+Per-call timing through the axon tunnel has a ~25 ms dispatch floor that
+swamps every stage (scripts/profile_round.py r2 findings), so here each
+stage runs inside a lax.scan with a scalar carry-dependency (preventing
+loop-invariant hoisting) and the whole loop is fenced once:
+
+    t_stage ~= (t_total - t_empty_scan) / n
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def scan_time(name, stage, n=20):
+    """stage: (pert_scalar) -> scalar; scanned n times, chained via carry."""
+
+    @jax.jit
+    def run():
+        def body(s, _):
+            out = stage(s * 1e-30)
+            return out * 1e-30, ()
+
+        s, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+        return s
+
+    float(run())  # compile + warm
+    t0 = time.perf_counter()
+    float(run())
+    dt = (time.perf_counter() - t0) / n * 1e3
+    print(f"{name:46s} {dt:8.2f} ms")
+    return dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("-n", type=int, default=20)
+    args = ap.parse_args()
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.ops import ravel_params
+    from commefficient_tpu.ops.countsketch import (
+        CountSketch, estimate_all, sketch_vec,
+    )
+    from commefficient_tpu.ops.topk import topk_threshold_dense
+
+    print(f"devices: {jax.devices()}")
+    workers, batch, k = 8, 64, 50_000
+    model = ResNet9(num_classes=10)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply)
+    vec, unravel = ravel_params(params)
+    d = int(vec.size)
+    spec = CountSketch(
+        d=d, c=500_000, r=5, seed=42,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(workers, batch, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(workers, batch)).astype(np.int32))
+    v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    table = jax.jit(lambda v: sketch_vec(spec, v))(v)
+    est = jax.jit(lambda t: estimate_all(spec, t))(table)
+    n = args.n
+
+    def grad_worker(s):
+        def per_w(xx, yy):
+            g = jax.grad(lambda p, b: loss_fn(p, b)[0])(
+                unravel(vec + s), {"x": xx, "y": yy}
+            )
+            return jax.flatten_util.ravel_pytree(g)[0]
+
+        return jnp.sum(jax.vmap(per_w)(x, y))
+
+    def grad_mono(s):
+        g = jax.grad(lambda p, b: loss_fn(p, b)[0])(
+            unravel(vec + s),
+            {"x": x.reshape(-1, 32, 32, 3), "y": y.reshape(-1)},
+        )
+        return jnp.sum(jax.flatten_util.ravel_pytree(g)[0])
+
+    scan_time("empty scan (overhead floor)", lambda s: s, n)
+    scan_time("fwd+bwd 8x64 (vmap per-worker)", grad_worker, n)
+    scan_time("fwd+bwd batch 512 (monolithic)", grad_mono, n)
+    scan_time("sketch_vec", lambda s: jnp.sum(sketch_vec(spec, v + s)), n)
+    scan_time("estimate_all", lambda s: jnp.sum(estimate_all(spec, table + s)), n)
+    scan_time("median only",
+              lambda s: jnp.sum(jnp.median(jnp.stack([est + s, est, est, est, est]), axis=0)), n)
+    scan_time("topk_threshold_dense",
+              lambda s: jnp.sum(topk_threshold_dense(est + s, k)), n)
+    scan_time("lax.top_k",
+              lambda s: jnp.sum(jax.lax.top_k(jnp.abs(est + s), k)[0]), n)
+    vp = jnp.pad(v, (0, spec.d_padded - d))
+    scan_time("roll+transpose (layout only)",
+              lambda s: jnp.sum(jnp.roll(vp + s, 123).reshape(spec.chunk_m, spec.nc).T), n)
+    scan_time("signs (mix32 iota)",
+              lambda s: jnp.sum(spec._row_signs(1) * (vp + s)), n)
+
+    # full rounds
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.config import Config
+
+    for mode, extra in [
+        ("uncompressed", {}),
+        ("sketch", dict(error_type="virtual", virtual_momentum=0.9,
+                        topk_method="threshold")),
+    ]:
+        cfg = Config(mode=mode, k=k, num_rows=5, num_cols=500_000,
+                     num_clients=2 * workers, num_workers=workers,
+                     num_devices=1, local_batch_size=batch,
+                     weight_decay=5e-4, **extra)
+        session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+        ids = jnp.arange(workers, dtype=jnp.int32)
+        data = {"x": x, "y": y}
+        round_fn = session.round_fn
+
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s2, m = round_fn(s, ids, data, jnp.float32(0.1))
+                return s2, m["loss"]
+
+            return jax.lax.scan(body, state, None, length=n)
+
+        st, losses = run(session.state)
+        float(losses[-1])
+        t0 = time.perf_counter()
+        st, losses = run(st)
+        float(losses[-1])
+        dt = (time.perf_counter() - t0) / n * 1e3
+        print(f"{'full round: ' + mode:46s} {dt:8.2f} ms "
+              f"({workers * batch / dt * 1e3:,.0f} samples/s)")
+
+
+if __name__ == "__main__":
+    main()
