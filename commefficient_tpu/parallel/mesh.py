@@ -42,9 +42,13 @@ def initialize_distributed() -> bool:
         "JAX_COORDINATOR_ADDRESS",  # explicit jax.distributed coordinator
         "COORDINATOR_ADDRESS",
         "MEGASCALE_COORDINATOR_ADDRESS",  # multislice runtime
-        "TPU_WORKER_HOSTNAMES",  # Cloud TPU pod metadata (auto-detect path)
     )
-    if not any(os.environ.get(k) for k in multi_host_signals):
+    multi_host = any(os.environ.get(k) for k in multi_host_signals)
+    # Cloud TPU pod metadata lists the slice's hosts; a single entry (e.g.
+    # the "localhost" the axon tunnel injects) is NOT a multi-host signal.
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi_host = multi_host or len([h for h in hostnames.split(",") if h]) > 1
+    if not multi_host:
         return False  # single-host; don't touch the backend at all
     # NB: must not call jax.process_count()/jax.devices() first — that would
     # initialize the local backend and make distributed.initialize() raise.
@@ -55,6 +59,22 @@ def initialize_distributed() -> bool:
             return True  # already initialized
     except (ImportError, AttributeError):
         pass
+    try:  # private API; if it moves, assume not-yet-initialized and proceed
+        from jax._src import xla_bridge as _xb
+
+        backend_up = _xb.backends_are_initialized()
+    except (ImportError, AttributeError):
+        backend_up = False
+    if backend_up:
+        # Too late to join the coordination service in this process (some
+        # jax op already ran); proceed single-process rather than crash.
+        import warnings
+
+        warnings.warn(
+            "multi-host coordinator configured but the XLA backend is "
+            "already initialized; skipping jax.distributed.initialize()"
+        )
+        return False
     jax.distributed.initialize()
     return True
 

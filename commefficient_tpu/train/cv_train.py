@@ -91,6 +91,23 @@ def build_model_and_data(cfg: Config):
     return train, test, real, model, params, loss_fn, augment
 
 
+def build_session_and_sampler(cfg: Config, train, params, loss_fn, augment):
+    """Session + sampler wiring shared by main() and scripts/accuracy_run.py.
+
+    The fedavg local_batch_size multiplier is THE convention to keep in one
+    place: each sampled round batch carries num_local_iters microbatches."""
+    session = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(
+        train,
+        num_workers=cfg.num_workers,
+        local_batch_size=cfg.local_batch_size
+        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
+        seed=cfg.seed,
+        augment=augment,
+    )
+    return session, sampler
+
+
 def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                test_ds, writer: Optional[MetricsWriter] = None,
                table: Optional[TableLogger] = None, eval_batch_size: int = 512,
@@ -181,18 +198,12 @@ def main(argv=None, **overrides):
     if not real:
         print("WARNING: real dataset not found on disk — synthetic stand-in "
               "(pipeline-correct; metrics are not paper numbers)")
-    session = FederatedSession(cfg, params, loss_fn)
+    session, sampler = build_session_and_sampler(
+        cfg, train, params, loss_fn, augment
+    )
     bpr = session.bytes_per_round()
     print(f"grad_size D={session.grad_size}  upload/client/round="
           f"{bpr['upload_bytes']:,} B  download={bpr['download_bytes']:,} B")
-    sampler = FedSampler(
-        train,
-        num_workers=cfg.num_workers,
-        local_batch_size=cfg.local_batch_size
-        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
-        seed=cfg.seed,
-        augment=augment,
-    )
     writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 

@@ -28,9 +28,11 @@ def main():
     ap.add_argument("--out", default="ACCURACY.md")
     args = ap.parse_args()
 
-    from commefficient_tpu.parallel import FederatedSession
-    from commefficient_tpu.train.cv_train import build_model_and_data, train_loop
-    from commefficient_tpu.data import FedSampler
+    from commefficient_tpu.train.cv_train import (
+        build_model_and_data,
+        build_session_and_sampler,
+        train_loop,
+    )
     from commefficient_tpu.utils.config import Config
 
     base = dict(
@@ -58,12 +60,8 @@ def main():
     real = None
     for name, cfg in runs:
         train, test, real, model, params, loss_fn, augment = build_model_and_data(cfg)
-        session = FederatedSession(cfg, params, loss_fn)
-        sampler = FedSampler(
-            train, num_workers=cfg.num_workers,
-            local_batch_size=cfg.local_batch_size
-            * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
-            seed=cfg.seed, augment=augment,
+        session, sampler = build_session_and_sampler(
+            cfg, train, params, loss_fn, augment
         )
         bpr = session.bytes_per_round()
         t0 = time.time()

@@ -18,6 +18,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
+import jax.flatten_util  # noqa: F401 — binds jax.flatten_util for the stages
 import jax.numpy as jnp
 import numpy as np
 
@@ -105,10 +106,10 @@ def main():
     est = est_j(table)
 
     r = args.reps
-    t_model = timeit("fwd+bwd batch 512 (monolithic)", fwd_bwd, vec, x, y, reps=r)
+    timeit("fwd+bwd batch 512 (monolithic)", fwd_bwd, vec, x, y, reps=r)
     t_modelw = timeit("fwd+bwd 8x64 (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
     t_sk = timeit("sketch_vec (dense d)", sketch_j, v, reps=r)
-    t_est = timeit("estimate_all", est_j, table, reps=r)
+    timeit("estimate_all", est_j, table, reps=r)
     timeit("lax.top_k k=50k over d", topk_j, est, reps=r)
     timeit("approx_max_k k=50k over d", approx_j, est, reps=r)
     t_thr = timeit("topk_threshold_dense k=50k", thr_j, est, reps=r)
@@ -122,19 +123,23 @@ def main():
           f"unsketch_dense {t_unskd:.1f} + resketch {t_sk:.1f} = {total:.1f} ms")
     print(f"-> {workers * batch / total * 1e3:,.0f} samples/s (bench does 512/round)")
 
-    # ground truth: the bench's scanned round (no dispatch overhead)
-    from commefficient_tpu.models import classification_loss as _cl
+    # ground truth: the EXACT bench config (bench.py r2: fuse_clients,
+    # batch 256, num_blocks 4) so this number reconciles against bench.py
     from commefficient_tpu.parallel import FederatedSession, make_mesh
     from commefficient_tpu.utils.config import Config
 
+    bench_batch = 256
     cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
-                 k=k, num_rows=5, num_cols=500_000, topk_method="threshold",
+                 k=k, num_rows=5, num_cols=500_000, num_blocks=4,
+                 topk_method="threshold", fuse_clients=True,
                  num_clients=2 * workers, num_workers=workers, num_devices=1,
-                 local_batch_size=batch, weight_decay=5e-4)
+                 local_batch_size=bench_batch, weight_decay=5e-4)
     session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
     ids = jnp.arange(workers, dtype=jnp.int32)
-    data = {"x": x.reshape(workers, batch, 32, 32, 3),
-            "y": y.reshape(workers, batch)}
+    data = {"x": jnp.asarray(rng.normal(
+                size=(workers, bench_batch, 32, 32, 3)).astype(np.float32)),
+            "y": jnp.asarray(rng.integers(
+                0, 10, size=(workers, bench_batch)).astype(np.int32))}
     round_fn = session.round_fn
     n = 10
 
@@ -152,7 +157,7 @@ def main():
     fence(losses)
     dt = (time.perf_counter() - t0) / n * 1e3
     print(f"scanned full round: {dt:.2f} ms -> "
-          f"{workers * batch / dt * 1e3:,.0f} samples/s")
+          f"{workers * bench_batch / dt * 1e3:,.0f} samples/s")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import jax
+import jax.flatten_util  # noqa: F401 — binds jax.flatten_util for the stages
 import jax.numpy as jnp
 import numpy as np
 
@@ -29,7 +30,9 @@ def scan_time(name, stage, n=20):
     def run():
         def body(s, _):
             out = stage(s * 1e-30)
-            return out * 1e-30, ()
+            # cast keeps the carry float32 even for bf16 stages (scan
+            # requires identical carry input/output types)
+            return out.astype(jnp.float32) * 1e-30, ()
 
         s, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
         return s
