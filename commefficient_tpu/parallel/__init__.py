@@ -20,6 +20,12 @@ from commefficient_tpu.parallel.ring_attention import (
     ring_attention_sharded,
 )
 from commefficient_tpu.parallel.sequence import sp_gpt2_apply
+from commefficient_tpu.parallel.tensor import (
+    build_tp3d_train_step,
+    tp_gpt2_apply,
+    tp_shard_params,
+    tp_untransform_params,
+)
 
 __all__ = [
     "make_mesh",
@@ -39,4 +45,8 @@ __all__ = [
     "ring_attention",
     "ring_attention_sharded",
     "sp_gpt2_apply",
+    "build_tp3d_train_step",
+    "tp_gpt2_apply",
+    "tp_shard_params",
+    "tp_untransform_params",
 ]

@@ -79,15 +79,16 @@ def ring_attention(q, k, v, *, axis_name: str = SEQ, causal: bool = True):
         kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
         return (kv, acc, m_new, den), ()
 
-    b, h, _, hd = q.shape
-    # accumulator inits are literals (replicated under shard_map's vma
-    # typing) but the scan carries varying values — pcast so carry types match
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    # accumulator inits derive from q (not literals) so they inherit q's
+    # FULL vma type — varying over ``axis_name`` and, when heads are also
+    # tensor-sharded (parallel/tensor.py), over ``model`` — keeping the
+    # scan carry types consistent with the body's outputs
+    q0 = q.astype(jnp.float32) * 0.0  # [B, H, T_local, hd]
     init = (
         (k, v),
-        vary(jnp.zeros((b, h, t_local, hd), jnp.float32)),
-        vary(jnp.full((b, h, t_local), _NEG_INF, jnp.float32)),
-        vary(jnp.zeros((b, h, t_local), jnp.float32)),
+        q0,
+        q0[..., 0] + _NEG_INF,
+        q0[..., 0],
     )
     (kv, acc, m_run, den), _ = jax.lax.scan(step, init, jnp.arange(n))
     out = acc / jnp.maximum(den[..., None], 1e-30)

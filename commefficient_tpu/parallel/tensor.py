@@ -1,0 +1,374 @@
+"""Tensor-parallel GPT-2 over the ``model`` mesh axis (+ optional ``seq``).
+
+No reference equivalent: the reference has no tensor parallelism anywhere
+(SURVEY.md §2 parallelism disclosure — its only strategy is federated data
+parallelism over worker processes). This is the TPU-native capability
+extension that falls out of the mesh formulation (SURVEY.md §5 rebuild
+column): Megatron-style sharding expressed as a ``shard_map``, with XLA
+collectives over ICI.
+
+Layout (the standard two-collective-per-block pattern):
+
+  * ``c_attn``: kernel reshaped ``[E, 3, H, hd]`` and sharded on H — each
+    device computes q/k/v for its local heads only; attention is embarrass-
+    ingly parallel across heads.
+  * attention ``c_proj``: kernel reshaped ``[H, hd, E]`` sharded on H — the
+    per-device partial output sums over devices via one ``psum``.
+  * MLP ``c_fc``: kernel ``[E, 4E]`` sharded on the hidden (output) axis;
+    ``c_proj``: ``[4E, E]`` sharded on the hidden (input) axis — second
+    ``psum``.
+  * LayerNorms, embeddings, LM/MC heads: replicated (tiny next to the
+    matmuls at GPT-2 scale).
+
+Composition with sequence parallelism: when the mesh's ``seq`` axis is >1,
+the token axis is additionally sharded over ``seq`` and attention runs the
+exact ring algorithm (``parallel.ring_attention``) over the LOCAL heads —
+2-D model sharding (heads x sequence) in one ``shard_map``. Combined with
+the batch (``workers``) axis in ``build_tp3d_train_step`` this is a full
+3-axis dp x tp x sp training step, verified token-exact against the dense
+single-device model in tests/test_tensor_parallel.py.
+
+Params flow through a one-time ``tp_transform_params`` reshape (pure
+memory-layout change) so every shard's slice is a contiguous block; use
+``tp_shard_params`` to ``device_put`` them with their NamedShardings so
+they stay resident on their shards across steps.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.models.gpt2 import GPT2Config, dense_causal_attention
+from commefficient_tpu.parallel.mesh import MODEL, SEQ, WORKERS
+from commefficient_tpu.parallel.ring_attention import ring_attention
+
+P = jax.sharding.PartitionSpec
+
+
+# --------------------------------------------------------------------------
+# Param transform + sharding specs
+# --------------------------------------------------------------------------
+
+
+def tp_transform_params(params, cfg: GPT2Config):
+    """Reshape attention/MLP kernels so the TP shard axis is contiguous.
+
+    ``{"params": {"transformer": {...}, "mc_head": {...}}}`` (the
+    GPT2DoubleHeads tree) -> a flat-ish dict with per-block entries whose
+    leading/trailing axes are the ones sharded in ``tp_param_specs``.
+    Inverse: ``tp_untransform_params``.
+    """
+    E, H = cfg.n_embd, cfg.n_head
+    hd = E // H
+    t = params["params"]["transformer"]
+    out: dict = {
+        "wte": t["wte"],
+        "wpe": t["wpe"],
+        "ln_f": t["ln_f"],
+        "mc_head": params["params"]["mc_head"],
+        "blocks": [],
+    }
+    for i in range(cfg.n_layer):
+        b = t[f"h_{i}"]
+        out["blocks"].append(
+            {
+                "ln_1": b["ln_1"],
+                "ln_2": b["ln_2"],
+                "attn_qkv_k": b["attn"]["c_attn"]["kernel"].reshape(E, 3, H, hd),
+                "attn_qkv_b": b["attn"]["c_attn"]["bias"].reshape(3, H, hd),
+                "attn_out_k": b["attn"]["c_proj"]["kernel"].reshape(H, hd, E),
+                "attn_out_b": b["attn"]["c_proj"]["bias"],
+                "fc_k": b["mlp"]["c_fc"]["kernel"],
+                "fc_b": b["mlp"]["c_fc"]["bias"],
+                "proj_k": b["mlp"]["c_proj"]["kernel"],
+                "proj_b": b["mlp"]["c_proj"]["bias"],
+            }
+        )
+    return out
+
+
+def tp_untransform_params(tp, cfg: GPT2Config):
+    """Inverse of ``tp_transform_params`` (e.g. for checkpointing)."""
+    E, H = cfg.n_embd, cfg.n_head
+    transformer = {"wte": tp["wte"], "wpe": tp["wpe"], "ln_f": tp["ln_f"]}
+    for i, b in enumerate(tp["blocks"]):
+        transformer[f"h_{i}"] = {
+            "ln_1": b["ln_1"],
+            "ln_2": b["ln_2"],
+            "attn": {
+                "c_attn": {
+                    "kernel": b["attn_qkv_k"].reshape(E, 3 * E),
+                    "bias": b["attn_qkv_b"].reshape(3 * E),
+                },
+                "c_proj": {
+                    "kernel": b["attn_out_k"].reshape(E, E),
+                    "bias": b["attn_out_b"],
+                },
+            },
+            "mlp": {
+                "c_fc": {"kernel": b["fc_k"], "bias": b["fc_b"]},
+                "c_proj": {"kernel": b["proj_k"], "bias": b["proj_b"]},
+            },
+        }
+    return {"params": {"transformer": transformer, "mc_head": tp["mc_head"]}}
+
+
+def tp_param_specs(tp_params) -> Any:
+    """PartitionSpec tree for a transformed tree: heads / MLP hidden on
+    ``model``, everything else replicated."""
+    spec_block = {
+        "ln_1": jax.tree.map(lambda _: P(), tp_params["blocks"][0]["ln_1"]),
+        "ln_2": jax.tree.map(lambda _: P(), tp_params["blocks"][0]["ln_2"]),
+        "attn_qkv_k": P(None, None, MODEL, None),
+        "attn_qkv_b": P(None, MODEL, None),
+        "attn_out_k": P(MODEL, None, None),
+        "attn_out_b": P(),
+        "fc_k": P(None, MODEL),
+        "fc_b": P(MODEL),
+        "proj_k": P(MODEL, None),
+        "proj_b": P(),
+    }
+    return {
+        "wte": P(),
+        "wpe": P(),
+        "ln_f": jax.tree.map(lambda _: P(), tp_params["ln_f"]),
+        "mc_head": jax.tree.map(lambda _: P(), tp_params["mc_head"]),
+        "blocks": [spec_block for _ in tp_params["blocks"]],
+    }
+
+
+def tp_shard_params(mesh, params, cfg: GPT2Config):
+    """Transform + device_put each leaf with its NamedSharding. Returns the
+    sharded transformed tree (pass to ``tp_gpt2_apply`` / the train step)."""
+    tp = tp_transform_params(params, cfg)
+    specs = tp_param_specs(tp)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, jax.sharding.NamedSharding(mesh, s)),
+        tp,
+        specs,
+    )
+
+
+# --------------------------------------------------------------------------
+# Forward (runs inside shard_map; all inputs are LOCAL shards)
+# --------------------------------------------------------------------------
+
+
+def _layer_norm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32), -1, keepdims=True) - jnp.square(mean)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _block_local(x, b, cfg: GPT2Config, attn_fn):
+    """One transformer block with local-head attention + sharded MLP.
+    x: [R, T_local, E] replicated over ``model``; psums over MODEL only."""
+    dt = cfg.dtype
+    h = _layer_norm(x, b["ln_1"], cfg.layer_norm_epsilon)
+    qkv = (
+        jnp.einsum("rte,echd->crthd", h, b["attn_qkv_k"].astype(dt))
+        + b["attn_qkv_b"].astype(dt)[:, None, None]
+    )
+    q, k, v = qkv[0], qkv[1], qkv[2]  # [R, T, H_local, hd]
+    to_bhtd = lambda u: u.transpose(0, 2, 1, 3)
+    attn = attn_fn(to_bhtd(q), to_bhtd(k), to_bhtd(v))  # [R, H_local, T, hd]
+    out = jnp.einsum("rhtd,hde->rte", attn.astype(dt), b["attn_out_k"].astype(dt))
+    out = jax.lax.psum(out, MODEL) + b["attn_out_b"].astype(dt)
+    x = x + out
+    h = _layer_norm(x, b["ln_2"], cfg.layer_norm_epsilon)
+    h1 = jax.nn.gelu(
+        h @ b["fc_k"].astype(dt) + b["fc_b"].astype(dt), approximate=True
+    )
+    h2 = h1 @ b["proj_k"].astype(dt)
+    h2 = jax.lax.psum(h2, MODEL) + b["proj_b"].astype(dt)
+    return x + h2
+
+
+def _forward_local(tp, ids, tt, mc, cfg: GPT2Config, seq_size: int):
+    """Local double-heads forward. ids/tt: [R, T_local] (T sharded over
+    ``seq`` when seq_size > 1); mc: [R] global token positions or None.
+    Returns (h [R, T_local, E], lm_logits [R, T_local, V],
+    mc_logits [R] | None)."""
+    t_local = ids.shape[-1]
+    if seq_size > 1:
+        me = jax.lax.axis_index(SEQ)
+        positions = me * t_local + jnp.arange(t_local)
+        attn_fn = partial(ring_attention, axis_name=SEQ)
+    else:
+        positions = jnp.arange(t_local)
+        attn_fn = dense_causal_attention
+    wte = tp["wte"]
+    h = wte[ids] + tp["wpe"][positions]
+    if tt is not None:
+        h = h + wte[tt]
+    h = h.astype(cfg.dtype)
+    for b in tp["blocks"]:
+        h = _block_local(h, b, cfg, attn_fn)
+    h = _layer_norm(h, tp["ln_f"], cfg.layer_norm_epsilon)
+    lm_logits = (h @ wte.astype(h.dtype).T).astype(jnp.float32)
+    if mc is None:
+        return h, lm_logits, None
+    rows = jnp.arange(mc.shape[0])
+    # each mc token position lives on exactly one seq shard: mask + psum
+    # (identity when the seq axis is size 1, and it keeps the output
+    # vma-invariant over ``seq`` either way)
+    off = jax.lax.axis_index(SEQ) * t_local
+    in_range = (mc >= off) & (mc < off + t_local)
+    local_idx = jnp.clip(mc - off, 0, t_local - 1)
+    picked = jnp.where(in_range[:, None], h[rows, local_idx], 0.0)
+    picked = jax.lax.psum(picked, SEQ)
+    mh = tp["mc_head"]
+    score = picked.astype(cfg.dtype) @ mh["kernel"].astype(cfg.dtype) + mh[
+        "bias"
+    ].astype(cfg.dtype)
+    return h, lm_logits, score[:, 0].astype(jnp.float32)
+
+
+def tp_gpt2_apply(mesh, model, tp_params, input_ids, token_type_ids=None,
+                  mc_token_ids=None):
+    """Tensor(-and-sequence)-parallel ``GPT2DoubleHeads.apply``.
+
+    input_ids/token_type_ids: [B, N, T]; mc_token_ids: [B, N]. The mesh's
+    ``model`` axis shards heads/MLP hidden; its ``seq`` axis (if > 1, T
+    divisible) shards tokens with ring attention. Returns
+    (lm_logits [B,N,T,V], mc_logits [B,N] | None) — same contract as the
+    dense model.
+    """
+    cfg = model.cfg
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    seq_size = sizes.get(SEQ, 1)
+    shape = input_ids.shape
+    if shape[-1] % seq_size != 0:
+        raise ValueError(f"T={shape[-1]} must divide by seq axis {seq_size}")
+    flat = lambda u: None if u is None else u.reshape(-1, shape[-1])
+    ids, tt = flat(input_ids), flat(token_type_ids)
+    mc = None if mc_token_ids is None else mc_token_ids.reshape(-1)
+    specs = tp_param_specs(tp_params)
+    tspec = P(None, SEQ)
+
+    def local(tp, ids, tt, mc):
+        _, lm, mc_logits = _forward_local(tp, ids, tt, mc, cfg, seq_size)
+        return lm, (jnp.zeros((1,), jnp.float32) if mc_logits is None else mc_logits)
+
+    lm, mc_out = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(specs, tspec, tspec if tt is not None else None,
+                  P() if mc is not None else None),
+        out_specs=(P(None, SEQ, None), P()),
+    )(tp_params, ids, tt, mc)
+    lm = lm.reshape(*shape, cfg.vocab_size)
+    if mc_token_ids is None:
+        return lm, None
+    return lm, mc_out.reshape(shape[:-1])
+
+
+# --------------------------------------------------------------------------
+# Full 3-axis training step: dp (workers) x tp (model) x sp (seq)
+# --------------------------------------------------------------------------
+
+
+def _ce_sums(logits, labels, ignore=-100):
+    """(sum of nll over valid labels, valid count) — psum-friendly."""
+    mask = (labels != ignore).astype(jnp.float32)
+    safe = jnp.where(labels == ignore, 0, labels)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
+    return jnp.sum(nll * mask), jnp.sum(mask)
+
+
+def build_tp3d_train_step(mesh, model, lm_coef: float = 1.0,
+                          mc_coef: float = 1.0):
+    """SGD train step for GPT-2 sharded over ALL THREE mesh axes.
+
+    batch (global arrays): {"input_ids"/"token_type_ids"/"lm_labels":
+    [B, N, T], "mc_token_ids": [B, N], "mc_labels": [B]} with B divisible
+    by the ``workers`` axis and T by ``seq``. Params: the
+    ``tp_shard_params`` tree. Returns jitted
+    ``step(tp_params, batch, lr) -> (new_tp_params, metrics)`` where the
+    batch is data-parallel over ``workers``, heads/MLP over ``model`` and
+    tokens over ``seq`` — gradient psums ride the ``workers`` axis exactly
+    once (DP all-reduce), the in-block psums ride ``model``/``seq``.
+    """
+    cfg = model.cfg
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    seq_size = sizes.get(SEQ, 1)
+
+    def local_loss(tp, batch):
+        shape = batch["input_ids"].shape  # local [b, N, T_local]
+        flat = lambda u: u.reshape(-1, u.shape[-1])
+        _, lm, mc_logits = _forward_local(
+            tp,
+            flat(batch["input_ids"]),
+            flat(batch["token_type_ids"]),
+            batch["mc_token_ids"].reshape(-1),
+            cfg,
+            seq_size,
+        )
+        lm = lm.reshape(*shape, cfg.vocab_size)
+        mc_logits = mc_logits.reshape(shape[:-1])
+        # next-token shift ACROSS seq shards: the label of local position j
+        # is lm_labels[global j + 1], so shift labels by one globally and
+        # mask the final global position (no next token). The sampler's
+        # labels are already local slices, so shift via ppermute: each
+        # shard's first label column moves to its left neighbor's tail.
+        labels = batch["lm_labels"]
+        if seq_size > 1:
+            # local position j's target is GLOBAL label j+1: shift locally
+            # and fetch the next shard's first label column for the tail
+            # (ppermute i -> i-1). The last shard's final position has no
+            # next token -> IGNORE_INDEX.
+            nxt = jax.lax.ppermute(
+                labels[..., :1], SEQ,
+                [(i, (i - 1) % seq_size) for i in range(seq_size)],
+            )
+            me = jax.lax.axis_index(SEQ)
+            nxt = jnp.where(me == seq_size - 1, -100, nxt)
+            labels = jnp.concatenate([labels[..., 1:], nxt], -1)
+            lm_logits_for_loss = lm
+        else:
+            labels = labels[..., 1:]
+            lm_logits_for_loss = lm[..., :-1, :]
+        lm_sum, lm_cnt = _ce_sums(lm_logits_for_loss, labels)
+        mc_sum, mc_cnt = _ce_sums(mc_logits, batch["mc_labels"])
+        sums = jnp.stack([lm_sum, lm_cnt, mc_sum, mc_cnt])
+        sums = jax.lax.psum(sums, (WORKERS, SEQ))
+        lm_loss = sums[0] / jnp.maximum(sums[1], 1.0)
+        mc_loss = sums[2] / jnp.maximum(sums[3], 1.0)
+        loss = lm_coef * lm_loss + mc_coef * mc_loss
+        return loss, {"lm_loss": lm_loss, "mc_loss": mc_loss}
+
+    def local_step(tp, batch, lr):
+        (loss, aux), grads = jax.value_and_grad(local_loss, has_aux=True)(tp, batch)
+        new_tp = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), tp, grads)
+        return new_tp, {"loss": loss, **aux}
+
+    def step(tp_params, batch, lr):
+        B, _, T = batch["input_ids"].shape
+        wk = sizes.get(WORKERS, 1)
+        if T % seq_size != 0:
+            raise ValueError(f"T={T} must divide by seq axis {seq_size}")
+        if B % wk != 0:
+            raise ValueError(f"B={B} must divide by workers axis {wk}")
+        specs = tp_param_specs(tp_params)
+        bspec = {
+            "input_ids": P(WORKERS, None, SEQ),
+            "token_type_ids": P(WORKERS, None, SEQ),
+            "lm_labels": P(WORKERS, None, SEQ),
+            "mc_token_ids": P(WORKERS),
+            "mc_labels": P(WORKERS),
+        }
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, bspec, P()),
+            out_specs=(specs, P()),
+        )(tp_params, batch, lr)
+
+    return jax.jit(step, donate_argnums=(0,))

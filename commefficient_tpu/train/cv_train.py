@@ -93,15 +93,12 @@ def build_model_and_data(cfg: Config):
 
 def build_session_and_sampler(cfg: Config, train, params, loss_fn, augment):
     """Session + sampler wiring shared by main() and scripts/accuracy_run.py.
-
-    The fedavg local_batch_size multiplier is THE convention to keep in one
-    place: each sampled round batch carries num_local_iters microbatches."""
+    (The fedavg microbatch convention lives in Config.sampler_batch_size.)"""
     session = FederatedSession(cfg, params, loss_fn)
     sampler = FedSampler(
         train,
         num_workers=cfg.num_workers,
-        local_batch_size=cfg.local_batch_size
-        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
+        local_batch_size=cfg.sampler_batch_size,
         seed=cfg.seed,
         augment=augment,
     )

@@ -213,8 +213,7 @@ def main(argv=None, **overrides):
     sampler = FedSampler(
         train,
         num_workers=cfg.num_workers,
-        local_batch_size=cfg.local_batch_size
-        * (cfg.num_local_iters if cfg.mode == "fedavg" else 1),
+        local_batch_size=cfg.sampler_batch_size,
         seed=cfg.seed,
     )
     writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)

@@ -149,6 +149,15 @@ class Config:
         return self.num_workers // self.num_devices
 
     @property
+    def sampler_batch_size(self) -> int:
+        """Samples the sampler draws per client per round. THE fedavg
+        convention, kept in one place: a fedavg round batch carries
+        ``num_local_iters`` microbatches of ``local_batch_size`` each."""
+        return self.local_batch_size * (
+            self.num_local_iters if self.mode == "fedavg" else 1
+        )
+
+    @property
     def resolved_num_classes(self) -> int:
         """num_classes if set, else derived from dataset_name."""
         if self.num_classes is not None:

@@ -53,7 +53,7 @@ def main():
     )
 
     print(f"devices: {jax.devices()}")
-    workers, batch = 8, 64
+    workers, batch = 8, 256  # the bench r2 shape (2048 samples/round)
     model = ResNet9(num_classes=10)
     params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
     loss_fn = classification_loss(model.apply)
@@ -106,8 +106,8 @@ def main():
     est = est_j(table)
 
     r = args.reps
-    timeit("fwd+bwd batch 512 (monolithic)", fwd_bwd, vec, x, y, reps=r)
-    t_modelw = timeit("fwd+bwd 8x64 (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
+    timeit(f"fwd+bwd batch {workers*batch} (monolithic)", fwd_bwd, vec, x, y, reps=r)
+    t_modelw = timeit(f"fwd+bwd {workers}x{batch} (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
     t_sk = timeit("sketch_vec (dense d)", sketch_j, v, reps=r)
     timeit("estimate_all", est_j, table, reps=r)
     timeit("lax.top_k k=50k over d", topk_j, est, reps=r)
@@ -121,14 +121,15 @@ def main():
     total = t_modelw + t_sk + t_unskd + t_sk
     print(f"\nround ≈ model {t_modelw:.1f} + sketch {t_sk:.1f} + "
           f"unsketch_dense {t_unskd:.1f} + resketch {t_sk:.1f} = {total:.1f} ms")
-    print(f"-> {workers * batch / total * 1e3:,.0f} samples/s (bench does 512/round)")
+    print(f"-> {workers * batch / total * 1e3:,.0f} samples/s "
+          f"(bench does {workers * batch}/round)")
 
     # ground truth: the EXACT bench config (bench.py r2: fuse_clients,
     # batch 256, num_blocks 4) so this number reconciles against bench.py
     from commefficient_tpu.parallel import FederatedSession, make_mesh
     from commefficient_tpu.utils.config import Config
 
-    bench_batch = 256
+    bench_batch = batch  # == the bench r2 shape profiled above
     cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
                  k=k, num_rows=5, num_cols=500_000, num_blocks=4,
                  topk_method="threshold", fuse_clients=True,
