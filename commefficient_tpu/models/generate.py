@@ -1,0 +1,193 @@
+"""Autoregressive GPT-2 decoding — KV cache + ``lax.scan``, static shapes.
+
+The reference's GPT-2 workload periodically samples continuations during
+training (``gpt2_train.py`` eval loop ~L280-360, SURVEY.md §2 "gpt2_train
+entry": "periodic generation/eval"). HF's torch ``generate`` is an eager
+per-token python loop; here decoding is written for the TPU/XLA model:
+
+* ONE compiled program: prompt prefill (dense causal forward that also
+  fills the per-layer K/V cache) + a ``lax.scan`` over the new positions,
+  each step attending its single query token against the cache. No
+  recompilation across steps, no dynamic shapes; compiled programs are
+  cached per (shape, sampling-config) key.
+* the caches are ``[L, B, H, T_total, hd]`` carried through the scan;
+  appends are ``lax.dynamic_update_slice`` at the traced position.
+* greedy (``temperature=0``) or temperature/top-k sampling with a jax PRNG.
+
+Consumes the SAME flax param tree as ``GPT2DoubleHeads`` (models/gpt2.py)
+— no separate decode weights. Exactness vs the dense model is pinned by
+tests/test_generate.py (greedy decode == argmax over full re-forwards).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.models.gpt2 import GPT2Config, manual_layer_norm as _ln
+
+_NEG = jnp.finfo(jnp.float32).min
+
+
+def _split_heads(u, H):
+    B, T, E = u.shape
+    return u.reshape(B, T, H, E // H).transpose(0, 2, 1, 3)  # [B,H,T,hd]
+
+
+def _qkv(h, blk, cfg):
+    """LN + packed qkv projection -> per-head q, k, v [B, H, Tq, hd]."""
+    dt = cfg.dtype
+    a = blk["attn"]["c_attn"]
+    x = _ln(h, blk["ln_1"], cfg.layer_norm_epsilon)
+    qkv = x @ a["kernel"].astype(dt) + a["bias"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return tuple(_split_heads(u, cfg.n_head) for u in (q, k, v))
+
+
+def _finish_block(h, blk, cfg, q, k_ctx, v_ctx, mask):
+    """Attention of ``q`` over (k_ctx, v_ctx) under ``mask`` [Tq, Tc]
+    (True = attend), then the output proj + MLP residuals."""
+    dt = cfg.dtype
+    hd = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_ctx).astype(jnp.float32)
+    scores = jnp.where(mask[None, None], scores / jnp.sqrt(jnp.float32(hd)), _NEG)
+    probs = jax.nn.softmax(scores, -1).astype(v_ctx.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v_ctx)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(h.shape)
+    a = blk["attn"]["c_proj"]
+    h = h + (ctx @ a["kernel"].astype(dt) + a["bias"].astype(dt))
+    x = _ln(h, blk["ln_2"], cfg.layer_norm_epsilon)
+    m = blk["mlp"]
+    x = jax.nn.gelu(
+        x @ m["c_fc"]["kernel"].astype(dt) + m["c_fc"]["bias"].astype(dt),
+        approximate=True,
+    )
+    return h + (x @ m["c_proj"]["kernel"].astype(dt) + m["c_proj"]["bias"].astype(dt))
+
+
+def _embed(t, ids, positions, tt, cfg):
+    h = t["wte"][ids] + t["wpe"][positions]
+    if tt is not None:
+        h = h + t["wte"][tt]
+    return h.astype(cfg.dtype)
+
+
+def _lm_logits(t, h_tok, cfg):
+    h1 = _ln(h_tok, t["ln_f"], cfg.layer_norm_epsilon)
+    return (h1 @ t["wte"].astype(h1.dtype).T).astype(jnp.float32)
+
+
+_RUN_CACHE: dict = {}
+
+
+def generate(
+    cfg: GPT2Config,
+    params,
+    input_ids: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    token_type_ids: Optional[jnp.ndarray] = None,
+    new_token_type: Optional[int] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+):
+    """Decode ``max_new_tokens`` continuations of ``input_ids`` [B, T0].
+
+    Returns [B, T0 + max_new_tokens]; once a row hits ``eos_token_id``
+    its remaining positions are filled with eos. ``temperature=0`` is
+    greedy; otherwise softmax sampling at that temperature, optionally
+    truncated to the ``top_k`` most likely tokens. ``new_token_type`` is
+    the token_type id embedded for generated positions (PersonaChat uses
+    the speaker token; None = no type embedding on new tokens).
+    """
+    B, T0 = input_ids.shape
+    T = T0 + max_new_tokens
+    if T > cfg.n_positions:
+        raise ValueError(f"T0+max_new={T} exceeds n_positions={cfg.n_positions}")
+    if rng is None:
+        rng = jax.random.key(0)
+    has_tt = token_type_ids is not None
+    key = (cfg, B, T0, max_new_tokens, has_tt, new_token_type, temperature,
+           top_k, eos_token_id)
+    run = _RUN_CACHE.get(key)
+    if run is None:
+        run = _RUN_CACHE[key] = _build_run(
+            cfg, B, T0, max_new_tokens, has_tt, new_token_type, temperature,
+            top_k, eos_token_id,
+        )
+    return run(params["params"]["transformer"], input_ids, token_type_ids, rng)
+
+
+def _build_run(cfg, B, T0, max_new, has_tt, new_token_type, temperature,
+               top_k, eos_token_id):
+    L, H, hd = cfg.n_layer, cfg.n_head, cfg.n_embd // cfg.n_head
+    T = T0 + max_new
+
+    def select(logits, r):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        logits = logits / jnp.float32(temperature)
+        if top_k > 0:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, _NEG, logits)
+        return jax.random.categorical(r, logits).astype(jnp.int32)
+
+    @jax.jit
+    def run(t, input_ids, token_type_ids, rng):
+        blocks = [t[f"h_{i}"] for i in range(L)]
+        # ---- prefill: dense causal pass over the prompt, cache filled ----
+        cache_k = jnp.zeros((L, B, H, T, hd), cfg.dtype)
+        cache_v = jnp.zeros((L, B, H, T, hd), cfg.dtype)
+        h = _embed(t, input_ids, jnp.arange(T0), token_type_ids, cfg)
+        causal = jnp.tril(jnp.ones((T0, T0), bool))
+        for i, blk in enumerate(blocks):
+            q, k, v = _qkv(h, blk, cfg)
+            cache_k = cache_k.at[i, :, :, :T0].set(k)
+            cache_v = cache_v.at[i, :, :, :T0].set(v)
+            h = _finish_block(h, blk, cfg, q, k, v, causal)
+        logits0 = _lm_logits(t, h[:, -1], cfg)
+
+        # ---- decode scan: step i feeds the token AT position T0+i and ----
+        # emits the token FOR position T0+i+1
+        def step(carry, i):
+            cache_k, cache_v, tok, done, rng = carry
+            pos = T0 + i  # position of the token being fed
+            rng, r = jax.random.split(rng)
+            tt1 = (
+                jnp.full((B, 1), new_token_type, jnp.int32)
+                if new_token_type is not None
+                else None
+            )
+            h = _embed(t, tok[:, None], pos[None], tt1, cfg)
+            mask = (jnp.arange(T) <= pos)[None, :]  # [1, T]
+            for j, blk in enumerate(blocks):
+                q1, k1, v1 = _qkv(h, blk, cfg)
+                ck = jax.lax.dynamic_update_slice(cache_k[j], k1, (0, 0, pos, 0))
+                cv = jax.lax.dynamic_update_slice(cache_v[j], v1, (0, 0, pos, 0))
+                cache_k = cache_k.at[j].set(ck)
+                cache_v = cache_v.at[j].set(cv)
+                h = _finish_block(h, blk, cfg, q1, ck, cv, mask)
+            logits = _lm_logits(t, h[:, 0], cfg)
+            nxt = select(logits, r)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (cache_k, cache_v, nxt, done, rng), tok
+
+        first = select(logits0, rng)
+        done0 = (
+            first == eos_token_id
+            if eos_token_id is not None
+            else jnp.zeros((B,), bool)
+        )
+        carry = (cache_k, cache_v, first, done0, rng)
+        carry, toks = jax.lax.scan(step, carry, jnp.arange(max_new - 1))
+        last = carry[2]
+        new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]
+        return jnp.concatenate([input_ids, new], axis=1)
+
+    return run

@@ -41,6 +41,19 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
 
 
+def manual_layer_norm(x, p, eps):
+    """LayerNorm applied to raw param dicts ``{"scale", "bias"}`` — fp32
+    stats (mean/E[x^2] like flax's fast-variance path), output in x.dtype.
+    Shared by every manual-forward path (parallel/tensor.py decode-free TP
+    forward, models/generate.py KV-cache decode) so their numerics stay
+    bit-matched to each other and to ``nn.LayerNorm``."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32), -1, keepdims=True) - jnp.square(mean)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
 def dense_causal_attention(q, k, v):
     """[B, H, T, hd] q/k/v -> [B, H, T, hd]; fp32 softmax, causal mask."""
     hd = q.shape[-1]

@@ -75,7 +75,21 @@ def initialize_distributed() -> bool:
             "already initialized; skipping jax.distributed.initialize()"
         )
         return False
-    jax.distributed.initialize()
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get(
+        "COORDINATOR_ADDRESS"
+    )
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if addr and nproc and pid:  # empty strings fall through to auto-detect
+        # explicit bring-up (e.g. CPU/GPU clusters, tests); TPU pod runtimes
+        # auto-detect below instead
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(nproc),
+            process_id=int(pid),
+        )
+    else:
+        jax.distributed.initialize()
     return True
 
 

@@ -42,7 +42,12 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from commefficient_tpu.models.gpt2 import GPT2Config, dense_causal_attention
+from commefficient_tpu.models.gpt2 import (
+    GPT2Config,
+    dense_causal_attention,
+    manual_layer_norm as _layer_norm,
+)
+from commefficient_tpu.models.losses import IGNORE_INDEX
 from commefficient_tpu.parallel.mesh import MODEL, SEQ, WORKERS
 from commefficient_tpu.parallel.ring_attention import ring_attention
 
@@ -158,14 +163,6 @@ def tp_shard_params(mesh, params, cfg: GPT2Config):
 # --------------------------------------------------------------------------
 
 
-def _layer_norm(x, p, eps):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, -1, keepdims=True)
-    var = jnp.mean(jnp.square(x32), -1, keepdims=True) - jnp.square(mean)
-    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
-    return (y * p["scale"] + p["bias"]).astype(x.dtype)
-
-
 def _block_local(x, b, cfg: GPT2Config, attn_fn):
     """One transformer block with local-head attention + sharded MLP.
     x: [R, T_local, E] replicated over ``model``; psums over MODEL only."""
@@ -274,7 +271,7 @@ def tp_gpt2_apply(mesh, model, tp_params, input_ids, token_type_ids=None,
 # --------------------------------------------------------------------------
 
 
-def _ce_sums(logits, labels, ignore=-100):
+def _ce_sums(logits, labels, ignore=IGNORE_INDEX):
     """(sum of nll over valid labels, valid count) — psum-friendly."""
     mask = (labels != ignore).astype(jnp.float32)
     safe = jnp.where(labels == ignore, 0, labels)
@@ -329,7 +326,7 @@ def build_tp3d_train_step(mesh, model, lm_coef: float = 1.0,
                 [(i, (i - 1) % seq_size) for i in range(seq_size)],
             )
             me = jax.lax.axis_index(SEQ)
-            nxt = jnp.where(me == seq_size - 1, -100, nxt)
+            nxt = jnp.where(me == seq_size - 1, IGNORE_INDEX, nxt)
             labels = jnp.concatenate([labels[..., 1:], nxt], -1)
             lm_logits_for_loss = lm
         else:

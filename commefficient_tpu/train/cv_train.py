@@ -44,7 +44,7 @@ from commefficient_tpu.utils import (
     parse_args,
     piecewise_linear_lr,
 )
-from commefficient_tpu.utils.logging import make_logdir
+from commefficient_tpu.utils.logging import drain_round_metrics, make_logdir
 
 
 def build_model_and_data(cfg: Config):
@@ -137,7 +137,17 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             print(f"resumed from checkpoint at round {step}")
     for epoch in range(step // steps_per_epoch, cfg.num_epochs):
         timer()
+        pending = []  # (step, lr, device-metrics); see drain_round_metrics
         train_loss, train_correct, train_count = 0.0, 0.0, 0.0
+
+        def acc(loss, metrics):
+            nonlocal train_loss, train_correct, train_count
+            train_loss += loss
+            train_correct += float(metrics.get("correct", 0.0))
+            train_count += float(metrics.get("count", 0.0))
+
+        drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
+
         for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
             if epoch * steps_per_epoch + round_idx < step:
                 continue  # fast-forward within the resumed epoch
@@ -150,15 +160,13 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             lr = float(lr_fn(step))
             profiler.step(step)
             metrics = session.train_round(client_ids, batch, lr)
-            train_loss += float(metrics["loss"])
-            train_correct += float(metrics.get("correct", 0.0))
-            train_count += float(metrics.get("count", 0.0))
-            if writer:
-                writer.scalar("train/loss", float(metrics["loss"]), step)
-                writer.scalar("lr", lr, step)
+            pending.append((step, lr, metrics))
             step += 1
             if checkpointer is not None:
+                if checkpointer.will_save(step):
+                    drain()
                 checkpointer.maybe_save(session, step)
+        drain()
         train_time = timer()
         val = session.evaluate(test_ds.eval_batches(eval_batch_size))
         val_time = timer()

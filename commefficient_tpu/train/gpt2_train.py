@@ -46,7 +46,7 @@ from commefficient_tpu.utils import (
     parse_args,
     piecewise_linear_lr,
 )
-from commefficient_tpu.utils.logging import make_logdir
+from commefficient_tpu.utils.logging import drain_round_metrics, make_logdir
 
 
 def build_model_and_data(cfg: Config):
@@ -96,7 +96,7 @@ def build_model_and_data(cfg: Config):
 def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                test_ds, writer: Optional[MetricsWriter] = None,
                table: Optional[TableLogger] = None, eval_batch_size: int = 8,
-               checkpointer=None):
+               checkpointer=None, gcfg=None):
     """Epoch loop with the reference's eval: nll -> ppl + MC accuracy
     (gpt2_train.py ~L280-360). Honors checkpoint_every/resume like
     cv_train.train_loop."""
@@ -120,7 +120,18 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             print(f"resumed from checkpoint at round {step}")
     for epoch in range(step // steps_per_epoch, cfg.num_epochs):
         timer()
+        pending = []  # (step, lr, device-metrics); see drain_round_metrics
         tr_loss = tr_lm = tr_mc = 0.0
+
+        def acc(loss, metrics):
+            nonlocal tr_loss, tr_lm, tr_mc
+            tr_loss += loss
+            # lm/mc aux are psum'd sums of per-client means -> / W
+            tr_lm += float(metrics.get("lm_loss", 0.0)) / W
+            tr_mc += float(metrics.get("mc_loss", 0.0)) / W
+
+        drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
+
         for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
             if epoch * steps_per_epoch + round_idx < step:
                 continue  # fast-forward within the resumed epoch
@@ -132,16 +143,13 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 }
             lr = float(lr_fn(step))
             metrics = session.train_round(client_ids, batch, lr)
-            tr_loss += float(metrics["loss"])
-            # lm/mc aux are psum'd sums of per-client means -> divide by W
-            tr_lm += float(metrics.get("lm_loss", 0.0)) / W
-            tr_mc += float(metrics.get("mc_loss", 0.0)) / W
-            if writer:
-                writer.scalar("train/loss", float(metrics["loss"]), step)
-                writer.scalar("lr", lr, step)
+            pending.append((step, lr, metrics))
             step += 1
             if checkpointer is not None:
+                if checkpointer.will_save(step):
+                    drain()
                 checkpointer.maybe_save(session, step)
+        drain()
         train_time = timer()
         val = evaluate_ppl(session, test_ds, eval_batch_size)
         val_time = timer()
@@ -163,7 +171,55 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             writer.scalar("val/ppl", val["ppl"], step)
             writer.scalar("val/mc_acc", val["mc_accuracy"], step)
             writer.flush()
+        if gcfg is not None:
+            # periodic generation (reference gpt2_train eval ~L280-360)
+            from commefficient_tpu.data.personachat import SPECIAL_TOKENS
+
+            prompt, gen = sample_generation(
+                session, gcfg, test_ds,
+                base_vocab=gcfg.vocab_size - len(SPECIAL_TOKENS),
+            )
+            print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
+                  f"-> {gen.tolist()}")
     return val
+
+
+def sample_generation(session: FederatedSession, gcfg, test_ds, base_vocab: int,
+                      max_new: int = 24):
+    """Decode a continuation of a held-out dialog — the reference's periodic
+    generation during training (gpt2_train.py eval loop ~L280-360). The
+    prompt is the gold candidate truncated at its reply start; the decode
+    runs with the <speaker2> token type and stops at <eos>. Returns
+    (prompt_ids, generated_ids) as numpy int arrays (token ids — decoding
+    to text needs the real tokenizer, which only exists when real
+    PersonaChat data is on disk)."""
+    from commefficient_tpu.data.personachat import special_ids
+    from commefficient_tpu.models.generate import generate
+    from commefficient_tpu.models.losses import IGNORE_INDEX
+
+    sp = special_ids(base_vocab)
+    b = next(iter(test_ds.eval_batches(1)))
+    mc = int(np.asarray(b["mc_labels"])[0])
+    row = np.asarray(b["input_ids"])[0, mc]
+    lab = np.asarray(b["lm_labels"])[0, mc]
+    tt = np.asarray(b["token_type_ids"])[0, mc]
+    nonmasked = np.nonzero(lab != IGNORE_INDEX)[0]
+    cut = int(nonmasked[0]) if len(nonmasked) else row.shape[0] // 2
+    # keep prompt + continuation inside n_positions: left-trim the prompt
+    # if a long dialog leaves no headroom (the dialog builder left-
+    # truncates too, so dropping the oldest context is consistent)
+    trim = max(0, cut + max_new - gcfg.n_positions)
+    prompt_ids, prompt_tt = row[trim:cut], tt[trim:cut]
+    out = generate(
+        gcfg,
+        session.params,
+        jnp.asarray(prompt_ids[None].astype(np.int32)),
+        max_new,
+        token_type_ids=jnp.asarray(prompt_tt[None].astype(np.int32)),
+        new_token_type=sp["<speaker2>"],
+        eos_token_id=sp["<eos>"],
+    )
+    return prompt_ids, np.asarray(out)[0, len(prompt_ids):]
 
 
 def evaluate_ppl(session: FederatedSession, test_ds, batch_size: int):
@@ -228,7 +284,7 @@ def main(argv=None, **overrides):
     )
     try:
         val = train_loop(cfg, session, sampler, test, writer,
-                         checkpointer=checkpointer)
+                         checkpointer=checkpointer, gcfg=gcfg)
         if checkpointer.enabled:
             checkpointer.maybe_save(session, int(session.state.step), force=True)
     finally:

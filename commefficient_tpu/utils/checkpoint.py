@@ -59,12 +59,19 @@ class FedCheckpointer:
     def enabled(self) -> bool:
         return self.mngr is not None
 
-    def maybe_save(self, session, round_idx: int, *, force: bool = False) -> bool:
-        """Save if ``checkpoint_every`` divides ``round_idx`` (or forced)."""
+    def will_save(self, round_idx: int, *, force: bool = False) -> bool:
+        """True iff ``maybe_save(round_idx)`` would write a checkpoint —
+        lets callers flush buffered logs BEFORE the state is persisted (a
+        resume fast-forwards past these rounds, so anything unflushed at
+        save time would be lost for good)."""
         if not self.enabled:
             return False
         every = self.cfg.checkpoint_every
-        if not force and (every <= 0 or round_idx == 0 or round_idx % every != 0):
+        return force or (every > 0 and round_idx > 0 and round_idx % every == 0)
+
+    def maybe_save(self, session, round_idx: int, *, force: bool = False) -> bool:
+        """Save if ``checkpoint_every`` divides ``round_idx`` (or forced)."""
+        if not self.will_save(round_idx, force=force):
             return False
         import orbax.checkpoint as ocp
 

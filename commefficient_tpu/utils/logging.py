@@ -95,3 +95,25 @@ class MetricsWriter:
         self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+
+
+def drain_round_metrics(pending, writer, accumulate) -> None:
+    """Fetch buffered per-round DEVICE metrics and clear the buffer.
+
+    Train loops append ``(step, lr, metrics)`` without fetching (a float()
+    per round is a full dispatch fence that serializes the round pipeline
+    — 10-100 ms each through a TPU tunnel) and drain at epoch end and
+    before checkpoint writes (a resume fast-forwards past checkpointed
+    rounds, so logs unflushed at save time would be lost for good). Writes
+    the common train/loss + lr scalars; per-workload accumulation goes
+    through ``accumulate(loss, metrics)``.
+    """
+    for s, s_lr, metrics in pending:
+        loss = float(metrics["loss"])
+        if writer:
+            writer.scalar("train/loss", loss, s)
+            writer.scalar("lr", s_lr, s)
+        accumulate(loss, metrics)
+    pending.clear()
+    if writer:
+        writer.flush()

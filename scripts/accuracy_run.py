@@ -70,8 +70,11 @@ def main():
         rows.append((name, bpr["upload_bytes"], bpr["download_bytes"],
                      val.get("accuracy", float("nan")), val["loss"], dt))
         print(f"== {name}: acc={rows[-1][3]:.4f} upload={bpr['upload_bytes']:,}B "
-              f"({dt:.0f}s)")
+              f"({dt:.0f}s)", flush=True)
+        _write(args, base, k, rows, real)  # incremental: survive interruption
 
+
+def _write(args, base, k, rows, real):
     label = "REAL CIFAR-10" if real else (
         "SYNTHETIC CIFAR stand-in (real pickles not on disk; numbers are "
         "pipeline/compression-quality evidence, NOT paper accuracy)")
@@ -95,7 +98,7 @@ def main():
         "compare row 2 against row 1 at the byte counts shown.",
     ]
     Path(args.out).write_text("\n".join(lines) + "\n")
-    print(f"wrote {args.out}")
+    print(f"wrote {args.out} ({len(rows)} rows)", flush=True)
 
 
 if __name__ == "__main__":

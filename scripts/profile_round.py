@@ -83,7 +83,7 @@ def main():
 
     @jax.jit
     def per_worker_fwd_bwd(pv, x, y):
-        # the actual bench shape: vmap over 8 workers of batch-64 grads
+        # the actual bench shape: vmap over `workers` grads of `batch` each
         xs = x.reshape(workers, batch, 32, 32, 3)
         ys = y.reshape(workers, batch)
         gs = jax.vmap(lambda xx, yy: fwd_bwd(pv, xx, yy))(xs, ys)

@@ -1,0 +1,69 @@
+"""Child process for tests/test_multihost.py — NOT a test module.
+
+Runs as ``python multihost_child.py <pid> <port>``: joins a 2-process
+jax.distributed cluster (4 virtual CPU devices each) through the
+PUBLIC bring-up path (``parallel.mesh.initialize_distributed`` reading
+JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID), then runs one
+federated sketch round over the 8-device global mesh — the multi-host
+capability SURVEY.md §5 names as the rebuild extension (psum across
+processes stands in for DCN).
+"""
+
+import os
+import sys
+
+pid, port = int(sys.argv[1]), sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from commefficient_tpu.utils.platform import force_virtual_cpu_devices  # noqa: E402
+
+force_virtual_cpu_devices(4)
+
+from commefficient_tpu.parallel.mesh import (  # noqa: E402
+    initialize_distributed,
+    make_mesh,
+)
+
+assert initialize_distributed() is True
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import flax.linen as nn  # noqa: E402
+
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+from commefficient_tpu.models import classification_loss  # noqa: E402
+from commefficient_tpu.parallel import FederatedSession  # noqa: E402
+from commefficient_tpu.utils.config import Config  # noqa: E402
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(4)(nn.tanh(nn.Dense(8)(x)))
+
+
+model = MLP()
+params = model.init(jax.random.key(0), jnp.zeros((1, 6)))
+loss_fn = classification_loss(model.apply)
+cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+             k=8, num_rows=3, num_cols=64, num_clients=16, num_workers=8,
+             num_devices=8, local_batch_size=4, weight_decay=0.0)
+session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(8))
+rng = np.random.default_rng(0)  # same seed both processes -> same batch
+ids = rng.choice(16, size=8, replace=False).astype(np.int32)
+batch = {"x": rng.normal(size=(8, 4, 6)).astype(np.float32),
+         "y": rng.integers(0, 4, size=(8, 4)).astype(np.int32)}
+loss = None
+for r in range(2):
+    m = session.train_round(ids, batch, lr=0.1)
+    loss = float(m["loss"])
+    assert np.isfinite(loss), loss
+print(f"MULTIHOST_OK pid={pid} loss={loss:.6f}")

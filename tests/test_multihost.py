@@ -1,0 +1,64 @@
+"""Multi-host bring-up: a REAL 2-process jax.distributed cluster on CPU.
+
+The reference has nothing like this (its world is one host's shared
+memory); SURVEY.md §5 "Distributed communication backend" names multi-host
+via jax.distributed as the rebuild's capability extension. This test runs
+it for real: two OS processes x 4 virtual CPU devices joined through
+``initialize_distributed()``, one 8-device global mesh, and a federated
+sketch round whose psum crosses the process boundary (Gloo standing in for
+DCN). Both processes must report the SAME loss — the aggregation is global.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+_CHILD = os.path.join(os.path.dirname(__file__), "multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_federated_round():
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # the child builds its own jax env from scratch
+        if not k.startswith(("JAX_", "XLA_"))
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHILD, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=280)
+            outs.append(out)
+    finally:
+        # a crashed child leaves its peer blocked in the cross-process
+        # psum forever — never leak the pair past the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+    losses = []
+    for out in outs:
+        m = re.search(r"MULTIHOST_OK pid=\d+ loss=([0-9.]+)", out)
+        assert m, out[-2000:]
+        losses.append(float(m.group(1)))
+    assert losses[0] == losses[1], f"processes disagree: {losses}"
