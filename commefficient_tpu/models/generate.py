@@ -178,7 +178,8 @@ def _build_run(cfg, B, T0, max_new, has_tt, new_token_type, temperature,
                 done = done | (nxt == eos_token_id)
             return (cache_k, cache_v, nxt, done, rng), tok
 
-        first = select(logits0, rng)
+        rng, r0 = jax.random.split(rng)  # never reuse a consumed key
+        first = select(logits0, r0)
         done0 = (
             first == eos_token_id
             if eos_token_id is not None

@@ -12,33 +12,43 @@ those are atomic-add/gather at memory bandwidth, but the TPU is a
 contiguous-vector machine with no fast random access (measured on v5e:
 a 50k-element scatter into 6.5M costs ~24 ms — microseconds of matmul).
 
-Blocked design (this module, v2):
-  * Coordinates are split into contiguous CHUNKS of ``m``; each chunk owns a
-    private block of ``s`` buckets, so the table has ``c = ceil(d/m) * s``
+Blocked design (this module, v3):
+  * Coordinates are split into CHUNKS of ``m``; each chunk owns a private
+    block of ``s`` buckets, so the table has ``c ~= ceil(d/m) * s``
     columns. Within a chunk, the bucket of a coordinate is a murmur-style
     hash of its WITHIN-CHUNK OFFSET, shared across chunks — so one static
     ``[m, s]`` one-hot matrix realizes the whole row as a single
     ``[nc, m] x [m, s]`` MXU matmul. No scatter, no per-chunk one-hot
     materialization (v1 generated ``d*s`` one-hot entries on the VPU per
     row — 30-50x slower than the MXU matmul).
-  * Per-row CYCLIC ROLL of the coordinate axis (a contiguous memory op)
-    shifts chunk boundaries, and ALTERNATE ROWS use a STRIDED chunk layout
-    (coordinate p -> chunk p mod nc, realized as a transpose — another
-    contiguous op): a pair of coordinates that shares a chunk (hence a
-    possibly-colliding bucket) in the contiguous rows is spread across
-    chunks in the strided rows, so no pair collides in every row and the
-    median rejects clustered-heavy-hitter crowding. Per-row SIGNS (hashed
-    from the ORIGINAL coordinate) make residual collision terms zero-mean.
-  * Estimation is the transposed matmul ``[nc, s] x [s, m]`` (again MXU),
-    followed by median across rows — no gather.
+  * Each row first applies a RIFFLE permutation with a per-row factor f
+    (``reshape(f, L/f).T`` — a pure transpose, a contiguous memory op):
+    a pair of coordinates at distance delta shares a chunk in row f only
+    when delta < m/f or delta lands near a multiple of L/f. Factors climb
+    geometrically to ~nc (see ``_riffle_factors``), so co-chunk partner
+    sets are (near-)disjoint across rows at EVERY distance scale — near
+    pairs separate in the high-factor rows, far pairs in the low-factor
+    rows. Per-row SIGNS (hashed from the ORIGINAL coordinate) make
+    residual collision terms zero-mean.
+  * Estimation is the transposed matmul ``[nc, s] x [s, m]`` (again MXU)
+    plus the inverse riffle, followed by median across rows — no gather.
 
-Sharing the offset-keyed hash across chunks does not change the collision
-statistics that matter: collisions only exist WITHIN a chunk (each chunk
-owns its own bucket block), a pair in the same chunk collides with
-probability 1/s per row exactly as in the classic sketch, and rows stay
-independent (per-row hash keys + roll + stride). Variance matches the
-classic sketch at equal table size: a coordinate's collision noise is
-||v_chunk||^2/s ~= ||v||^2 * (m/d)/s = ||v||^2/c.
+Why the riffle is load-bearing (v2 POSTMORTEM — do not regress): v2
+staggered rows with cyclic rolls plus a strided layout on alternate rows.
+Rolls shift chunk BOUNDARIES but keep neighborhoods intact, so all
+contiguous rows shared the same ~m co-chunk partners per coordinate; with
+only ``s`` buckets per chunk, the SAME partner pair then collided in >= 2
+of r rows orders of magnitude more often than in a classic sketch
+(expected 2-row repeat partners ~ m/s^2 per coordinate vs ~ d/c^2).
+Repeated-partner collisions corrupt the median in a CORRELATED way, and
+FetchSGD's error feedback re-banks and re-extracts the corruption every
+round: measured as exponential divergence on ResNet-9 at paper-scale
+settings (d/c=13, k=d/130, lr 0.4, momentum 0.9) while a classic scatter
+sketch converged under the identical server algebra. With per-row riffles
+the partner sets are disjoint and repeated-partner rates return to
+classic-sketch levels (regression-tested in tests/test_countsketch.py).
+Per-coordinate collision variance is unchanged: ||v_chunk||^2/s ~
+||v||^2/c.
 
 Linearity is the contract that makes federated aggregation exact:
 ``sketch(a) + sketch(b) == sketch(a + b)`` (bit-exact in float32 mode up to
@@ -54,6 +64,7 @@ All functions are pure and jit/vmap/shard_map-friendly.
 
 from __future__ import annotations
 
+import functools as _functools
 from typing import Any, NamedTuple
 
 import jax
@@ -63,6 +74,112 @@ import numpy as np
 _M1 = np.uint32(0x85EBCA6B)
 _M2 = np.uint32(0xC2B2AE35)
 _GOLDEN = np.uint32(0x9E3779B9)
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    i = 2
+    while i * i <= n:
+        if n % i == 0:
+            return False
+        i += 1
+    return True
+
+
+def _nearest_prime_leq(n: int) -> int:
+    while n >= 2 and not _is_prime(n):
+        n -= 1
+    return max(n, 1)
+
+
+def _next_prime_geq(n: int) -> int:
+    n = max(n, 2)
+    while not _is_prime(n):
+        n += 1
+    return n
+
+
+@_functools.lru_cache(maxsize=None)
+def _riffle_factors(d: int, m: int, r: int) -> tuple:
+    """Per-row riffle factors (always distinct).
+
+    A pair of coordinates at distance delta is co-chunked in row f only
+    when ``delta < m/f`` (its "window") or delta lands near a multiple of
+    L/f. The median over r rows is corrupted only when >= ceil(r/2) rows
+    co-chunk the same pair, so the factor set must keep the number of
+    rows whose window covers any given delta BELOW that.
+
+    Strong regime (nc >= m, i.e. d >= ~m^2 — the CV production scales;
+    GPT-2's d/c~100 pushes m above sqrt(d) for pool size and lands in the
+    small regime with ~330-bucket pools):
+    factors are (1, ~sqrt(m), then for row i >= 2 a prime near nc/g_i
+    with g_i the i-th odd-indexed prime (2, 3, 5, ...)). Those rows have
+    window m/f ~= g_i m^2/d of order one, so near pairs co-chunk in at
+    most ~2 rows, AND — critically — their far-pair lattices have
+    spacings G = L/f ~= g_i * m that are pairwise DISTINCT (a pair lands
+    on >= 2 giant rows' lattices only at lcm-scale spacings). Taking
+    consecutive primes >= nc instead makes L = m*f and G = m for EVERY
+    giant row — identical far-pair partner sets across rows, the v2
+    repeated-partner pathology at lattice scale (measured: |Se| -> 1e9 in
+    the fixed-input iteration). mf ~= d also keeps padding ~O(1%).
+
+    Small regime (nc < m): a geometric prime ladder 1..~nc, bumping any
+    factor out of the bad padding zone mf in (d/2, d) (where L = 2mf
+    nearly doubles the row and halves its bucket pool). Windows can't
+    shrink below m/nc > 1 without multi-x padding, so near pairs remain
+    co-chunked in several rows; with the >=128 bucket pools this measures
+    stable in the FetchSGD feedback iteration, but adversarially tight
+    heavy-hitter clusters can still produce phantoms at this scale (the
+    strong regime, or an explicit smaller ``m``, avoids them).
+    """
+    nc0 = max(1, -(-d // m))
+
+    def lattice(f: int) -> int:
+        # padded lattice spacing G = L/f in units of m: ceil(nc0/f)
+        return -(-nc0 // f)
+
+    def pick(target: int, fs: list, used_g: set) -> int:
+        """Smallest prime >= target whose f AND padded lattice spacing G
+        are both unused. G-distinctness is the invariant (two rows with
+        equal G share their entire far-pair partner lattice — the v2
+        repeated-partner pathology at lattice scale; composite/bumped
+        factors hit this through padding, e.g. f=5 and f=6 at nc0=10 both
+        give G=2m). When every G >= target is exhausted (tiny nc0), fall
+        back to a distinct prime with the least-used G."""
+        f = _next_prime_geq(max(2, target))
+        for _ in range(10_000):
+            if f not in fs and lattice(f) not in used_g:
+                return f
+            f = _next_prime_geq(f + 1)
+            if lattice(f) <= 1 and 1 in used_g:
+                break  # G saturated at m; no distinct G above here
+        f = _next_prime_geq(max(2, target))
+        while f in fs:
+            f = _next_prime_geq(f + 1)
+        return f
+
+    fs = [1]
+    used_g = {lattice(1)}
+    if r == 1:
+        return tuple(fs)
+    if nc0 >= m:
+        targets = [max(2, int(round(m ** 0.5)))]
+        g = 2
+        for _ in range(2, r):
+            targets.append(max(2, nc0 // g))
+            g = _next_prime_geq(g + 1)
+    else:
+        targets = [
+            max(2, int(round(nc0 ** (row / max(r - 1, 1)))))
+            for row in range(1, r)
+        ]
+    for t in targets:
+        if 0.5 < (m * t) / d < 1.0:  # bad padding zone: jump past ~nc
+            t = nc0
+        f = pick(t, fs, used_g)
+        fs.append(f)
+        used_g.add(lattice(f))
+    return tuple(fs)
 
 
 def _mix32(x: jnp.ndarray, key) -> jnp.ndarray:
@@ -99,29 +216,60 @@ class CountSketch(NamedTuple):
     # -- derived static geometry ------------------------------------------
     @property
     def chunk_m(self) -> int:
-        """Chunk size. Adaptive default: grow m (512..8192, powers of 2)
-        until each chunk gets >= 32 buckets, so the per-chunk floor of 8
-        can't inflate the realized table far beyond the request at large
-        d/c ratios (GPT-2 scale: d=124M, c=1.25M needs m=4096)."""
+        """Chunk size. Adaptive default: grow m (512..16384, powers of 2)
+        until each chunk gets >= 256 buckets.
+
+        The bucket-pool target is STABILITY-critical, not a tuning nicety:
+        with small pools the per-chunk victim sets are so small that
+        FetchSGD's extract-and-subtract feedback loop amplifies collision
+        noise instead of damping it. Measured on the fixed-input
+        iteration at d=6.6M, c=d/13 (t=59 |Se|max; classic scatter sketch
+        = 1526): s=40 -> 2.8e13, s=80 -> 8.7e6, s=160 -> 6981, s=312 ->
+        1812, s=624 -> 1680. s~256+ is classic-equivalent; the adaptive
+        rule targets that. The larger m also keeps the per-chunk floor of
+        8 from inflating the realized table at large d/c (GPT-2 scale:
+        d=124M, c=1.25M -> m=32768, s~328 — inside the measured-stable
+        pool band; the cap bounds the [m, s] one-hot operand at ~40 MB,
+        and d/c~100 is outside the band's measurement regime, so validate
+        long GPT-2 sketch runs empirically)."""
         if self.m is not None:
             return min(self.m, _ceil_mult(self.d, 8))
         m = 512
-        while m < 8192 and self.d / m > self.c / 32:
+        while m < 32768 and self.d / m > self.c / 256:
             m *= 2
         return min(m, _ceil_mult(self.d, 8))
 
     @property
     def nc(self) -> int:
-        return -(-self.d // self.chunk_m)
+        # chunk count of the LARGEST row (each row pads independently so
+        # its riffle factor divides its padded length)
+        return max(self._nc_row(r) for r in range(self.r))
 
-    @property
-    def s(self) -> int:
-        raw = max(1, round(self.c / self.nc))
+    def _factor(self, row: int) -> int:
+        return _riffle_factors(self.d, self.chunk_m, self.r)[row]
+
+    def _L_row(self, row: int) -> int:
+        """Per-row padded length: smallest multiple of m * factor >= d."""
+        return _ceil_mult(self.d, self.chunk_m * self._factor(row))
+
+    def _nc_row(self, row: int) -> int:
+        return self._L_row(row) // self.chunk_m
+
+    def s_row(self, row: int) -> int:
+        """Buckets per chunk for THIS row: targets the full requested c
+        regardless of the row's padding (a heavily padded row must not
+        shrink every other row's bucket pool — the shared-s version of
+        that measurably destabilized the feedback loop)."""
+        raw = max(1, round(self.c / self._nc_row(row)))
         return max(8, round(raw / 8) * 8)  # nearest multiple of 8
 
     @property
+    def s(self) -> int:
+        return self.s_row(0)
+
+    @property
     def c_actual(self) -> int:
-        return self.nc * self.s
+        return max(self._nc_row(r) * self.s_row(r) for r in range(self.r))
 
     @property
     def d_padded(self) -> int:
@@ -142,68 +290,66 @@ class CountSketch(NamedTuple):
             x = ((x ^ (x >> 16)) * int(_M1)) & 0xFFFFFFFF
         return np.uint32(x ^ int(_GOLDEN))
 
-    def _roll(self, row: int) -> int:
-        """Per-row coordinate shift: staggers chunk boundaries across rows."""
-        return (row * self.chunk_m) // max(self.r, 1) + row
-
-    def _strided(self, row: int) -> bool:
-        """Alternate rows lay chunks out strided (p -> chunk p mod nc)."""
-        return row % 2 == 1 and self.nc > 1
-
     def _row_signs(self, row: int) -> jnp.ndarray:
-        """[d_padded] ±1, hashed from the ORIGINAL coordinate index."""
-        idx = jnp.arange(self.d_padded, dtype=jnp.uint32)
+        """[d] ±1, hashed from the ORIGINAL coordinate index."""
+        idx = jnp.arange(self.d, dtype=jnp.uint32)
         bits = _mix32(idx, self._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
         return 1.0 - 2.0 * bits.astype(jnp.float32)
 
     def _offset_slots(self, row: int) -> jnp.ndarray:
         """[m] int32 bucket per within-chunk offset (shared by all chunks)."""
         off = jnp.arange(self.chunk_m, dtype=jnp.uint32)
-        return (_mix32(off, self._row_key(row)) % jnp.uint32(self.s)).astype(
-            jnp.int32
-        )
+        return (
+            _mix32(off, self._row_key(row)) % jnp.uint32(self.s_row(row))
+        ).astype(jnp.int32)
 
     def _row_onehot(self, row: int) -> jnp.ndarray:
         """[m, s] static one-hot of ``_offset_slots`` — the whole row's hash
         as one small matmul operand."""
         slots = self._offset_slots(row)
-        return (slots[:, None] == jnp.arange(self.s, dtype=jnp.int32)).astype(
-            self.dtype
-        )
+        return (
+            slots[:, None] == jnp.arange(self.s_row(row), dtype=jnp.int32)
+        ).astype(self.dtype)
 
 
-def _to_layout(spec: "CountSketch", x_flat: jnp.ndarray, row: int) -> jnp.ndarray:
-    """[d_padded] position-ordered -> [nc, m] chunk layout for this row.
+def _to_layout(spec: "CountSketch", x_d: jnp.ndarray, row: int) -> jnp.ndarray:
+    """[d] position-ordered -> [nc_row, m] chunk layout for this row.
 
-    Contiguous rows: position p -> (chunk p // m, offset p % m).
-    Strided rows:    position p -> (chunk p % nc, offset p // nc).
+    Riffle with factor f: original coordinate p lands at riffled index
+    ``(p mod G) * f + p // G`` with ``G = L_row / f`` — realized as
+    ``reshape(f, G).T``, a contiguous transpose. Chunks are then
+    contiguous blocks of m. f=1 rows are plain contiguous chunking.
     """
-    if spec._strided(row):
-        return x_flat.reshape(spec.chunk_m, spec.nc).T
-    return x_flat.reshape(spec.nc, spec.chunk_m)
+    f, L = spec._factor(row), spec._L_row(row)
+    xp = jnp.pad(x_d, (0, L - spec.d))
+    if f > 1:
+        xp = xp.reshape(f, L // f).T.reshape(L)
+    return xp.reshape(L // spec.chunk_m, spec.chunk_m)
 
 
 def _from_layout(spec: "CountSketch", x_chunks: jnp.ndarray, row: int) -> jnp.ndarray:
-    """[nc, m] chunk layout -> [d_padded] position-ordered (inverse)."""
-    if spec._strided(row):
-        return x_chunks.T.reshape(spec.d_padded)
-    return x_chunks.reshape(spec.d_padded)
+    """[nc_row, m] chunk layout -> [d] position-ordered (inverse)."""
+    f, L = spec._factor(row), spec._L_row(row)
+    xp = x_chunks.reshape(L)
+    if f > 1:
+        xp = xp.reshape(L // f, f).T.reshape(L)
+    return xp[: spec.d]
 
 
 def _ceil_mult(x: int, q: int) -> int:
     return -(-x // q) * q
 
 
-def _sketch_one_row(spec: CountSketch, v_padded: jnp.ndarray, row: int) -> jnp.ndarray:
-    sv = v_padded * spec._row_signs(row)
-    sv = _to_layout(spec, jnp.roll(sv, spec._roll(row)), row)
+def _sketch_one_row(spec: CountSketch, v: jnp.ndarray, row: int) -> jnp.ndarray:
+    sv = _to_layout(spec, v * spec._row_signs(row), row)
     out = jnp.einsum(
         "cm,ms->cs",
         sv.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
     )
-    return out.reshape(spec.c_actual)
+    out = out.reshape(spec._nc_row(row) * spec.s_row(row))
+    return jnp.pad(out, (0, spec.c_actual - out.shape[0]))
 
 
 def sketch_vec(spec: CountSketch, v: jnp.ndarray) -> jnp.ndarray:
@@ -213,8 +359,7 @@ def sketch_vec(spec: CountSketch, v: jnp.ndarray) -> jnp.ndarray:
     fresh table. Linear: ``sketch_vec(a+b) == sketch_vec(a)+sketch_vec(b)``.
     """
     v = v.astype(jnp.float32)
-    vp = jnp.pad(v, (0, spec.d_padded - spec.d))
-    return jnp.stack([_sketch_one_row(spec, vp, r) for r in range(spec.r)])
+    return jnp.stack([_sketch_one_row(spec, v, r) for r in range(spec.r)])
 
 
 def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -224,15 +369,16 @@ def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp
 
 
 def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
-    tab = table_row.reshape(spec.nc, spec.s)
+    nc_r = spec._nc_row(row)
+    s_r = spec.s_row(row)
+    tab = table_row[: nc_r * s_r].reshape(nc_r, s_r)
     est = jnp.einsum(
         "cs,ms->cm",
         tab.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
     )
-    est = jnp.roll(_from_layout(spec, est, row), -spec._roll(row))
-    return est * spec._row_signs(row)
+    return _from_layout(spec, est, row) * spec._row_signs(row)
 
 
 def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
@@ -253,21 +399,19 @@ def _row_cols_signs(spec: CountSketch, idx: jnp.ndarray, row: int):
     row — the gather/scatter-side view of the same mapping
     ``_sketch_one_row`` realizes with roll + layout + one-hot matmul."""
     idx = idx.astype(jnp.uint32)
-    pos = (idx + jnp.uint32(spec._roll(row) % spec.d_padded)) % jnp.uint32(
-        spec.d_padded
-    )
-    if spec._strided(row):
-        chunk = (pos % jnp.uint32(spec.nc)).astype(jnp.int32)
-        off = pos // jnp.uint32(spec.nc)
-    else:
-        chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
-        off = pos % jnp.uint32(spec.chunk_m)
-    h = (_mix32(off, spec._row_key(row)) % jnp.uint32(spec.s)).astype(jnp.int32)
-    # signs are keyed by the ORIGINAL coordinate (applied pre-roll in
+    f, L = spec._factor(row), spec._L_row(row)
+    G = jnp.uint32(L // f)
+    # riffled index of original coordinate p: (p mod G) * f + p // G
+    pos = (idx % G) * jnp.uint32(f) + idx // G
+    chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
+    off = pos % jnp.uint32(spec.chunk_m)
+    s_r = spec.s_row(row)
+    h = (_mix32(off, spec._row_key(row)) % jnp.uint32(s_r)).astype(jnp.int32)
+    # signs are keyed by the ORIGINAL coordinate (applied pre-riffle in
     # _sketch_one_row), slots by the within-chunk offset
     bits = _mix32(idx, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
     sign = 1.0 - 2.0 * bits.astype(jnp.float32)
-    return chunk * spec.s + h, sign
+    return chunk * s_r + h, sign
 
 
 def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:

@@ -50,9 +50,10 @@ def topk_threshold_dense(v: jnp.ndarray, k: int, iters: int = 32) -> jnp.ndarray
         too_many = jnp.sum(mag >= mid) > k
         return jnp.where(too_many, mid, lo), jnp.where(too_many, hi, mid)
 
-    lo, hi = jax.lax.fori_loop(
-        0, iters, body, (jnp.zeros((), mag.dtype), hi0)
-    )
+    # lo derives from hi0 (not a literal) so it inherits v's full vma type —
+    # under shard_map a literal init would be axis-invariant while the body
+    # output varies, a carry type mismatch (seen in local_topk workers)
+    lo, hi = jax.lax.fori_loop(0, iters, body, (hi0 * 0.0, hi0))
     # hi is the smallest tested threshold with count <= k; (mag > 0) guards
     # the all-zero vector (hi stays 0 there and >= would select everything).
     # Degenerate case: >k coordinates tie at the max, so NO magnitude

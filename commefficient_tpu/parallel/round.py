@@ -174,6 +174,18 @@ def build_round_fn(
         gathers/scatters the participants' rows around each call.
     """
     _validate(cfg)
+    if cfg.mode == "sketch" and cfg.momentum_dampening:
+        import warnings
+
+        warnings.warn(
+            "momentum_dampening in sketch mode subtracts the sketch of "
+            "ESTIMATED momentum values; the estimate noise injected into "
+            "the momentum sketch every round measurably destabilizes "
+            "training at paper-scale settings (diverges ~step 70 where "
+            "the unmasked run converges). FetchSGD's Algorithm 1 does not "
+            "mask sketched momentum — prefer momentum_dampening=False "
+            "here (dense modes mask exactly and are unaffected)."
+        )
     W = cfg.num_workers
     f32 = jnp.float32
 

@@ -119,42 +119,80 @@ def test_determinism_across_instances(spec):
 
 
 def test_hash_quality(spec):
-    """Slots roughly uniform; signs roughly balanced; rows decorrelated."""
+    """Slots roughly uniform; signs roughly balanced; rows decorrelated.
+    s varies per row (per-row padding), so every check uses s_row."""
     all_slots = []
     for row in range(R):
+        s_r = spec.s_row(row)
         slots = np.asarray(spec._offset_slots(row))  # [m] per-offset buckets
-        counts = np.bincount(slots, minlength=spec.s)
+        assert slots.max() < s_r
+        counts = np.bincount(slots, minlength=s_r)
         # m balls into s bins: max load within a small factor of the mean
-        assert counts.max() <= 4 * max(1.0, spec.chunk_m / spec.s)
+        mean_load = spec.chunk_m / s_r
+        assert counts.max() <= 4 * max(1.0, mean_load)
+        assert counts.min() >= 0.25 * mean_load - 3  # no starved buckets
         signs = np.asarray(spec._row_signs(row))
         assert abs(signs.mean()) < 0.05
         all_slots.append(slots)
-    # slot agreement between rows ~ 1/s (independent hashing per row)
+    # slot agreement between rows ~ 1/max(s_i, s_j), with binomial slack
     for i in range(R):
         for j in range(i + 1, R):
             agree = np.mean(all_slots[i] == all_slots[j])
-            assert abs(agree - 1.0 / spec.s) < 0.05
+            expect = 1.0 / max(spec.s_row(i), spec.s_row(j))
+            sigma = (expect / spec.chunk_m) ** 0.5
+            assert abs(agree - expect) < 6 * sigma + 1e-3, (i, j, agree, expect)
 
 
-def test_rolls_differ_across_rows(spec):
-    """Per-row rolls stagger chunk boundaries, so near pairs don't share a
-    chunk in every row (the property that lets the median reject same-chunk
-    collision noise)."""
-    rolls = {spec._roll(r) for r in range(R)}
-    assert len(rolls) == R
+def test_riffle_factors_differ_across_rows(spec):
+    """Each row riffles with a distinct prime factor, so co-chunk partner
+    sets are disjoint across rows (the property that keeps the median
+    sound — see the v2 postmortem in the module docstring)."""
+    factors = [spec._factor(r) for r in range(R)]
+    assert len(set(factors)) == R
 
 
-def test_recovers_clustered_heavy_hitters(spec):
+def test_repeated_partner_collisions_at_classic_rate(spec):
+    """v2 POSTMORTEM REGRESSION: the number of coordinate PAIRS that share
+    a bucket in >= 2 of the r rows must be near the classic-sketch rate
+    (~ D^2 * C(r,2) / (2 c^2)), not the ~(c/s)x inflated rate of the v2
+    roll/stride layout. That inflation is what made FetchSGD error
+    feedback diverge."""
+    from commefficient_tpu.ops.countsketch import _row_cols_signs
+
+    idx = jnp.arange(D)
+    cols = np.stack(
+        [np.asarray(_row_cols_signs(spec, idx, r)[0]) for r in range(R)]
+    )  # [R, D] bucket column of every coordinate per row
+    c = spec.c_actual
+    pairs_2row = 0
+    for i in range(R):
+        for j in range(i + 1, R):
+            key = cols[i].astype(np.int64) * c + cols[j]
+            counts = np.bincount(key - key.min())
+            pairs_2row += int((counts * (counts - 1) // 2).sum())
+    classic_expect = D * D * (R * (R - 1) / 2) / (2.0 * c * c)
+    # v2 measured ~100-200x classic here; allow generous stochastic slack
+    assert pairs_2row <= 8 * classic_expect + 20, (
+        f"{pairs_2row} repeated-partner pairs vs classic ~{classic_expect:.0f}"
+    )
+
+
+def test_recovers_clustered_heavy_hitters():
     """Adversarial for the blocked layout: heavy hitters packed into ONE
-    contiguous chunk region must still be recovered (within-chunk capacity
-    s >> 20 plus cross-row rolls)."""
+    contiguous run must be recovered without phantoms. Uses a spec in the
+    riffle ladder's STRONG regime (nc >= m — the production-scale shape;
+    here via an explicit small m), where any coordinate pair co-chunks in
+    at most 2 of 5 rows and the median is clean. The adaptive-m default at
+    toy d sits in the documented weak regime (see _riffle_factors)."""
+    cspec = CountSketch(d=D, c=C, r=R, seed=7, m=64)
+    assert cspec._nc_row(0) >= cspec.chunk_m  # strong regime
     rng = np.random.default_rng(9)
     v = rng.normal(0, 1.0, size=D).astype(np.float32)
-    start = 3 * spec.chunk_m + 17
+    start = 3 * cspec.chunk_m + 17
     hh = np.arange(start, start + 20)
     v[hh] += 100.0 * rng.choice([-1.0, 1.0], size=20)
-    table = sketch_vec(spec, jnp.asarray(v))
-    rec = unsketch(spec, table, k=20)
+    table = sketch_vec(cspec, jnp.asarray(v))
+    rec = unsketch(cspec, table, k=20)
     rec_idx = set(np.nonzero(np.asarray(rec))[0].tolist())
     assert set(hh.tolist()) <= rec_idx
 
@@ -174,18 +212,21 @@ def test_sketch_sparse_matches_dense_sketch(spec):
     )
 
 
-def test_error_feedback_subtraction_zeroes_estimates(spec):
-    """After e -= sketch_sparse(hh, est(hh)), estimates at hh are exactly 0 —
-    the linearity identity the server's error feedback relies on."""
+def test_error_feedback_subtraction_cancels_heavy_mass(spec):
+    """After e -= sketch_sparse(hh, est(hh)), estimates at hh drop from
+    heavy scale (~100) to noise scale — the linearity property the
+    server's error feedback relies on. Not exactly zero: when two heavy
+    coords share a bucket in some row, the subtraction shifts that row's
+    estimate and the median lands on another row's collision noise."""
     rng = np.random.default_rng(12)
     v, hh = planted_vector(D, 10, rng)
     table = sketch_vec(spec, v)
     hh_idx = jnp.asarray(hh.astype(np.int32))
     vals = estimate_at(spec, table, hh_idx)
+    assert np.abs(np.asarray(vals)).min() > 50.0  # heavies seen at scale
     table2 = table - sketch_sparse(spec, hh_idx, vals)
-    np.testing.assert_allclose(
-        np.asarray(estimate_at(spec, table2, hh_idx)), 0.0, atol=1e-4
-    )
+    residual = np.abs(np.asarray(estimate_at(spec, table2, hh_idx)))
+    assert residual.max() < 10.0, residual  # noise scale, not heavy scale
 
 
 def test_unsketch_sparse_matches_dense(spec):
