@@ -86,12 +86,6 @@ def _is_prime(n: int) -> bool:
     return True
 
 
-def _nearest_prime_leq(n: int) -> int:
-    while n >= 2 and not _is_prime(n):
-        n -= 1
-    return max(n, 1)
-
-
 def _next_prime_geq(n: int) -> int:
     n = max(n, 2)
     while not _is_prime(n):
@@ -200,9 +194,11 @@ class CountSketch(NamedTuple):
     spec is a hashable static NamedTuple (safe to close over under ``jit``)
     and the table is a plain ``[r, c]`` float array threaded functionally.
 
-    ``c`` is a TARGET column count: the realized count is
-    ``ceil(d/m) * s`` with ``s = round(c / ceil(d/m))`` clamped to a
-    multiple of 8 — within a few percent of the request for large d.
+    ``c`` is a TARGET column count: each row realizes ``nc_row * s_row``
+    columns (rows pad independently for their riffle factors; ``s_row``
+    re-targets c per row, clamped to a multiple of 8) and the table width
+    ``c_actual`` is the max over rows — within a few percent of the
+    request for large d.
     """
 
     d: int  # length of the vectors being sketched
@@ -216,7 +212,7 @@ class CountSketch(NamedTuple):
     # -- derived static geometry ------------------------------------------
     @property
     def chunk_m(self) -> int:
-        """Chunk size. Adaptive default: grow m (512..16384, powers of 2)
+        """Chunk size. Adaptive default: grow m (512..32768, powers of 2)
         until each chunk gets >= 256 buckets.
 
         The bucket-pool target is STABILITY-critical, not a tuning nicety:
@@ -270,10 +266,6 @@ class CountSketch(NamedTuple):
     @property
     def c_actual(self) -> int:
         return max(self._nc_row(r) * self.s_row(r) for r in range(self.r))
-
-    @property
-    def d_padded(self) -> int:
-        return self.nc * self.chunk_m
 
     @property
     def table_shape(self) -> tuple[int, int]:
@@ -397,7 +389,8 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
 def _row_cols_signs(spec: CountSketch, idx: jnp.ndarray, row: int):
     """(column index, sign) of each ORIGINAL coordinate in ``idx`` for one
     row — the gather/scatter-side view of the same mapping
-    ``_sketch_one_row`` realizes with roll + layout + one-hot matmul."""
+    ``_sketch_one_row`` realizes with riffle + chunk layout + one-hot
+    matmul."""
     idx = idx.astype(jnp.uint32)
     f, L = spec._factor(row), spec._L_row(row)
     G = jnp.uint32(L // f)

@@ -71,8 +71,12 @@ def build_model_and_data(cfg: Config):
         num_classes = 62
         augment = None
     elif cfg.dataset_name == "imagenet":
+        # num_classes must reach the loader too: the synthetic fallback
+        # otherwise fabricates 1000-class labels against a smaller head
+        # (out-of-range gather in the CE under jit)
         train, test, real = load_fed_imagenet(
-            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
+            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid,
+            seed=cfg.seed, num_classes=cfg.resolved_num_classes,
         )
         sample_shape = (1,) + train.data["x"].shape[1:]
         num_classes = cfg.resolved_num_classes

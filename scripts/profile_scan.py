@@ -104,11 +104,11 @@ def main():
               lambda s: jnp.sum(topk_threshold_dense(est + s, k)), n)
     scan_time("lax.top_k",
               lambda s: jnp.sum(jax.lax.top_k(jnp.abs(est + s), k)[0]), n)
-    vp = jnp.pad(v, (0, spec.d_padded - d))
-    scan_time("roll+transpose (layout only)",
-              lambda s: jnp.sum(jnp.roll(vp + s, 123).reshape(spec.chunk_m, spec.nc).T), n)
+    from commefficient_tpu.ops.countsketch import _to_layout
+    scan_time("riffle layout only (row 2)",
+              lambda s: jnp.sum(_to_layout(spec, v + s, 2)), n)
     scan_time("signs (mix32 iota)",
-              lambda s: jnp.sum(spec._row_signs(1) * (vp + s)), n)
+              lambda s: jnp.sum(spec._row_signs(1) * (v + s)), n)
 
     # full rounds
     from commefficient_tpu.parallel import FederatedSession, make_mesh

@@ -63,3 +63,39 @@ def test_graft_dryrun_multichip_8():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_cv_train_imagenet_fixup_end_to_end(tmp_path):
+    """BASELINE config #5 shape (shrunk): FixupResNet-50 on ImageNet via
+    the real npy-cache path (a tiny 64-image cache written here —
+    the synthetic fallback's 20k images are TPU-run scale, not CPU-test
+    scale), uncompressed over the mesh. Also regression-tests that
+    num_classes reaches the loader (labels < head size)."""
+    import os
+
+    rng = np.random.default_rng(3)
+    root = tmp_path / "imagenet"
+    os.makedirs(root)
+    np.save(root / "imagenet_x.npy",
+            rng.integers(0, 256, size=(64, 64, 64, 3)).astype(np.uint8))
+    np.save(root / "imagenet_y.npy",
+            rng.integers(0, 10, size=(64,)).astype(np.int32))
+    val = cv_main(
+        [],
+        dataset_name="imagenet",
+        model="fixup_resnet50",
+        num_classes=10,
+        mode="uncompressed",
+        num_clients=4,
+        num_workers=2,
+        num_devices=2,
+        local_batch_size=2,
+        num_epochs=1,
+        pivot_epoch=1,
+        lr_scale=0.05,
+        dataset_dir=str(tmp_path),
+        logdir=str(tmp_path / "runs"),
+        seed=0,
+    )
+    assert np.isfinite(val["loss"])
+    assert 0.0 <= val["accuracy"] <= 1.0
