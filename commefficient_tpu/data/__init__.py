@@ -6,7 +6,7 @@ Host-side numpy throughout (runs outside jit), mirroring the reference's
 """
 
 from commefficient_tpu.data.fed_dataset import FedDataset
-from commefficient_tpu.data.sampler import FedSampler
+from commefficient_tpu.data.sampler import FedSampler, prefetch
 from commefficient_tpu.data.cifar import (
     load_fed_cifar10,
     load_fed_cifar100,
@@ -24,6 +24,7 @@ from commefficient_tpu.data.personachat import (
 __all__ = [
     "FedDataset",
     "FedSampler",
+    "prefetch",
     "load_fed_cifar10",
     "load_fed_cifar100",
     "augment_batch",

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import os
 import pickle
-from typing import Dict, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import numpy as np
 
@@ -61,35 +61,156 @@ def _synthetic_cifar(
 
 
 def normalize(x_uint8: np.ndarray) -> np.ndarray:
-    """uint8 HWC -> normalized float32 (cifar10-fast prep)."""
+    """uint8 HWC -> normalized float32 (cifar10-fast prep) — host-side.
+
+    The training pipeline no longer calls this at load: batches stay uint8
+    end-to-end on the host and normalization happens ON DEVICE inside the
+    loss (``device_normalizer``), because the host->TPU link is the train
+    loop's bottleneck (measured ~40 MB/s through the axon tunnel — a
+    float32 CIFAR round costs ~310 ms of transfer, uint8 a quarter of
+    that). Kept for tools that want host-side floats.
+    """
     return ((x_uint8.astype(np.float32) / 255.0) - CIFAR10_MEAN) / CIFAR10_STD
 
 
-def augment_batch(batch: Dict[str, np.ndarray], rng: np.random.Generator) -> Dict[str, np.ndarray]:
-    """pad4 + random crop 32 + hflip + cutout8, on normalized float images.
+def device_normalizer(mean: np.ndarray, std: np.ndarray):
+    """Build the on-device input prep for ``classification_loss``: uint8
+    [B,H,W,C] -> normalized float32 (a VPU op XLA fuses into the model's
+    first conv); float inputs pass through unchanged (legacy/normalized
+    caches)."""
 
-    Host-side numpy (outside jit), vectorized over the batch — the analog of
-    the reference's torchvision transform pipeline.
+    def prep(x):
+        import jax.numpy as jnp
+
+        if x.dtype == jnp.uint8:
+            return (x.astype(jnp.float32) / 255.0 - mean) / std
+        return x
+
+    return prep
+
+
+class AugmentPlan(NamedTuple):
+    """Per-image augmentation draws (crop offsets in padded coords, flips,
+    cutout centers) — separated from the pixel work so the sampler can hand
+    the plan to the native fused gather+augment kernel
+    (commefficient_tpu.native)."""
+
+    ys: np.ndarray  # [n] int, 0..2*pad
+    xs: np.ndarray  # [n] int
+    flips: np.ndarray  # [n] bool
+    cys: np.ndarray  # [n] int, cutout center rows
+    cxs: np.ndarray  # [n] int
+
+
+class CifarAugment:
+    """pad(4) + random crop + hflip + cutout(8) — cifar10-fast prep, the
+    analog of the reference's torchvision transform pipeline
+    (``data_utils/fed_cifar.py`` ~L1-120).
+
+    ``plan()`` draws the randomness; ``apply()`` is the vectorized numpy
+    pixel path (the native C++ kernel in native/fedloader.cc and the jnp
+    ``device_augment`` are bit-identical — pinned by
+    tests/test_native_loader.py and tests/test_device_data.py). Calling
+    the object with ``(batch, rng)`` keeps the legacy per-batch API.
+
+    Cutout fill: the reference applies cutout AFTER normalization, so its
+    fill of 0.0 is the per-channel MEAN pixel. This pipeline augments
+    uint8 (pre-normalization — the host->device link wants uint8), so the
+    uint8 fill must be the mean in BYTE space (``fill_uint8``, default
+    round(255*CIFAR10_MEAN)); float inputs are assumed already normalized
+    and keep the 0.0 fill. Filling plain black in uint8 would inject a
+    ~2-sigma outlier patch into every image after normalization.
     """
-    x = batch["x"]
+
+    pad = 4
+    cut_half = 4  # cutout8: an 8x8 window [c-4, c+4)
+
+    def __init__(self, fill_uint8=None):
+        if fill_uint8 is None:
+            fill_uint8 = np.round(255.0 * CIFAR10_MEAN).astype(np.uint8)
+        self.fill_uint8 = np.asarray(fill_uint8, np.uint8)
+
+    def _fill(self, dtype, c: int) -> np.ndarray:
+        if dtype == np.uint8:
+            f = self.fill_uint8
+            return np.broadcast_to(f, (c,)).astype(np.uint8)
+        return np.zeros((c,), dtype)
+
+    def plan(self, rng: np.random.Generator, n: int, h: int = 32, w: int = 32) -> AugmentPlan:
+        return AugmentPlan(
+            ys=rng.integers(0, 2 * self.pad + 1, size=n),
+            xs=rng.integers(0, 2 * self.pad + 1, size=n),
+            flips=rng.random(n) < 0.5,
+            cys=rng.integers(0, h, size=n),
+            cxs=rng.integers(0, w, size=n),
+        )
+
+    def apply(self, x: np.ndarray, p: AugmentPlan) -> np.ndarray:
+        """[n, h, w, c] -> augmented copy (crop, then flip, then cutout —
+        the order matters: cutout centers are in post-flip coords)."""
+        n, h, w, c = x.shape
+        pad = self.pad
+        padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
+        iy = p.ys[:, None] + np.arange(h)  # [n, h]
+        ix = p.xs[:, None] + np.arange(w)  # [n, w]
+        out = padded[np.arange(n)[:, None, None], iy[:, :, None], ix[:, None, :]]
+        out[p.flips] = out[p.flips, :, ::-1]
+        ch = self.cut_half
+        ymask = (np.arange(h)[None, :] >= p.cys[:, None] - ch) & (
+            np.arange(h)[None, :] < p.cys[:, None] + ch
+        )
+        xmask = (np.arange(w)[None, :] >= p.cxs[:, None] - ch) & (
+            np.arange(w)[None, :] < p.cxs[:, None] + ch
+        )
+        mask = ymask[:, :, None] & xmask[:, None, :]
+        fill = self._fill(out.dtype, c)
+        out[mask] = fill
+        return out
+
+    def __call__(self, batch: Dict[str, np.ndarray], rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        x = batch["x"]
+        p = self.plan(rng, x.shape[0], x.shape[1], x.shape[2])
+        return {**batch, "x": self.apply(x, p)}
+
+
+#: module-level instance — the historical function-style entry point.
+augment_batch = CifarAugment()
+
+
+def device_augment(x, ys, xs, flips, cys, cxs, *, pad: int = 4,
+                   cut_half: int = 4, fill=None):
+    """``CifarAugment.apply`` as traced jnp ops, for the device-resident
+    data path (the round gathers + augments INSIDE the jitted program, so
+    only indices and this plan cross the host->device link).
+
+    Crop/flip/cutout are pure index/select ops — bit-identical to the
+    numpy/native paths on any dtype (pinned by tests/test_device_data.py).
+    x: [n, h, w, c]; plan arrays: [n]; fill: [c] cutout fill (see
+    CifarAugment's fill note; None = zeros).
+    """
+    import jax.numpy as jnp
+
     n, h, w, c = x.shape
-    padded = np.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)), mode="reflect")
-    out = np.empty_like(x)
-    ys = rng.integers(0, 9, size=n)
-    xs = rng.integers(0, 9, size=n)
-    flips = rng.random(n) < 0.5
-    cy = rng.integers(0, h, size=n)
-    cx = rng.integers(0, w, size=n)
-    for i in range(n):
-        img = padded[i, ys[i] : ys[i] + h, xs[i] : xs[i] + w]
-        if flips[i]:
-            img = img[:, ::-1]
-        img = img.copy()
-        y0, y1 = max(0, cy[i] - 4), min(h, cy[i] + 4)
-        x0, x1 = max(0, cx[i] - 4), min(w, cx[i] + 4)
-        img[y0:y1, x0:x1] = 0.0
-        out[i] = img
-    return {**batch, "x": out}
+    padded = jnp.pad(
+        x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+    )
+    iy = ys[:, None] + jnp.arange(h)  # [n, h]
+    ix = xs[:, None] + jnp.arange(w)  # [n, w]
+    out = padded[jnp.arange(n)[:, None, None], iy[:, :, None], ix[:, None, :]]
+    out = jnp.where(flips[:, None, None, None], out[:, :, ::-1, :], out)
+    ymask = (jnp.arange(h)[None, :] >= cys[:, None] - cut_half) & (
+        jnp.arange(h)[None, :] < cys[:, None] + cut_half
+    )
+    xmask = (jnp.arange(w)[None, :] >= cxs[:, None] - cut_half) & (
+        jnp.arange(w)[None, :] < cxs[:, None] + cut_half
+    )
+    mask = ymask[:, :, None] & xmask[:, None, :]
+    fill_v = (
+        jnp.zeros((c,), x.dtype)
+        if fill is None
+        else jnp.asarray(np.broadcast_to(fill, (c,)), x.dtype)
+    )
+    return jnp.where(mask[..., None], fill_v, out)
 
 
 def _load_cifar100(root: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
@@ -120,10 +241,8 @@ def load_fed_cifar10(
         train, test = _load_cifar10_batches(dataset_dir)
     else:
         train, test = _synthetic_cifar(num_classes)
-    train = {"x": normalize(train["x"]), "y": train["y"]}
-    test = {"x": normalize(test["x"]), "y": test["y"]}
-    tr = FedDataset(train, num_clients, iid=iid, seed=seed)
-    te = FedDataset(test, 1, iid=True, seed=seed)
+    tr = FedDataset(dict(train), num_clients, iid=iid, seed=seed)
+    te = FedDataset(dict(test), 1, iid=True, seed=seed)
     return tr, te, real
 
 
@@ -141,8 +260,6 @@ def load_fed_cifar100(
         train, test = _load_cifar100(dataset_dir)
     else:
         train, test = _synthetic_cifar(100)
-    train = {"x": normalize(train["x"]), "y": train["y"]}
-    test = {"x": normalize(test["x"]), "y": test["y"]}
-    tr = FedDataset(train, num_clients, iid=iid, seed=seed)
-    te = FedDataset(test, 1, iid=True, seed=seed)
+    tr = FedDataset(dict(train), num_clients, iid=iid, seed=seed)
+    te = FedDataset(dict(test), 1, iid=True, seed=seed)
     return tr, te, real

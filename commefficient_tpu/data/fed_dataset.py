@@ -98,15 +98,23 @@ class FedDataset:
         return self.n
 
     # -- batch access -----------------------------------------------------
+    def client_batch_indices(
+        self, client_id: int, batch_size: int, rng: np.random.Generator
+    ) -> np.ndarray:
+        """Sample a batch of GLOBAL indices from one client's shard (with
+        replacement iff the shard is smaller than the batch, as the
+        reference's per-client DataLoader effectively does for tiny
+        clients). Index-only so the sampler can fuse the gather across all
+        of a round's clients into one native-kernel pass."""
+        ix = self.client_indices[client_id]
+        replace = len(ix) < batch_size
+        return rng.choice(ix, size=batch_size, replace=replace)
+
     def client_batch(
         self, client_id: int, batch_size: int, rng: np.random.Generator
     ) -> Dict[str, np.ndarray]:
-        """Sample a batch from one client's shard (with replacement iff the
-        shard is smaller than the batch, as the reference's per-client
-        DataLoader effectively does for tiny clients)."""
-        ix = self.client_indices[client_id]
-        replace = len(ix) < batch_size
-        chosen = rng.choice(ix, size=batch_size, replace=replace)
+        """Gathered form of ``client_batch_indices`` (same rng draws)."""
+        chosen = self.client_batch_indices(client_id, batch_size, rng)
         return {k: v[chosen] for k, v in self.data.items()}
 
     def eval_batches(self, batch_size: int):

@@ -15,7 +15,9 @@ without serializing generator state.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Tuple
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +44,31 @@ class FedSampler:
         self.local_batch_size = local_batch_size
         self.seed = seed
         self.augment = augment
+        # fused batch assembly: one flat [W*B] gather (+ augment) per round
+        # instead of per-client gather/augment/stack — the native C++
+        # kernel when available, vectorized numpy otherwise. Requires a
+        # plan-based augment (data.cifar.CifarAugment) or none.
+        self._planner = augment if hasattr(augment, "plan") else None
+        x = dataset.data.get("x")
+        self._fusable = (
+            (augment is None or self._planner is not None)
+            and all(isinstance(v, np.ndarray) for v in dataset.data.values())
+            and (
+                self._planner is None
+                or (
+                    isinstance(x, np.ndarray)
+                    and x.ndim == 4
+                    and x.dtype in (np.float32, np.uint8)
+                )
+            )
+        )
+
+    @property
+    def fusable(self) -> bool:
+        """True when rounds can be assembled by fused index-gather (and so
+        also driven fully from device-resident data via
+        ``sample_round_indices``)."""
+        return self._fusable
 
     def steps_per_epoch(self) -> int:
         """Rounds per epoch such that one epoch visits ~the whole dataset,
@@ -55,6 +82,8 @@ class FedSampler:
         clients = rng.choice(
             self.dataset.num_clients, size=self.num_workers, replace=False
         )
+        if self._fusable:
+            return clients.astype(np.int32), self._fused_round(clients, rng)
         shards = []
         for c in clients:
             b = self.dataset.client_batch(int(c), self.local_batch_size, rng)
@@ -66,8 +95,111 @@ class FedSampler:
         }
         return clients.astype(np.int32), batch
 
+    def _fused_round(self, clients: np.ndarray, rng: np.random.Generator) -> Batch:
+        """One flat gather (+ augment) for the whole round's [W*B] samples."""
+        from commefficient_tpu import native
+
+        W, B = self.num_workers, self.local_batch_size
+        flat = np.concatenate(
+            [
+                self.dataset.client_batch_indices(int(c), B, rng)
+                for c in clients
+            ]
+        ).astype(np.int64)
+        batch: Batch = {}
+        data = self.dataset.data
+        for k, v in data.items():
+            if k == "x" and self._planner is not None:
+                p = self._planner.plan(rng, W * B, v.shape[1], v.shape[2])
+                out = native.gather_augment(
+                    v, flat, p,
+                    pad=self._planner.pad, cut_half=self._planner.cut_half,
+                    fill=self._planner._fill(v.dtype, v.shape[-1]),
+                )
+                if out is None:  # no native lib: numpy gather + apply
+                    out = self._planner.apply(np.ascontiguousarray(v[flat]), p)
+            else:
+                out = native.gather_rows(v, flat)
+                if out is None:
+                    out = v[flat]
+            batch[k] = out.reshape((W, B) + out.shape[1:])
+        return batch
+
+    def sample_round_indices(self, round_idx: int):
+        """(client_ids [W] int32, idx [W, B] int32, plan) — the index-only
+        form of ``sample_round`` for the device-resident-data path: the rng
+        draw sequence is IDENTICAL to ``_fused_round``, so gathering
+        ``data[idx]`` and applying ``plan`` on device reproduces the host
+        batch bit-for-bit."""
+        rng = np.random.default_rng((self.seed, round_idx))
+        clients = rng.choice(
+            self.dataset.num_clients, size=self.num_workers, replace=False
+        )
+        W, B = self.num_workers, self.local_batch_size
+        flat = np.concatenate(
+            [self.dataset.client_batch_indices(int(c), B, rng) for c in clients]
+        ).astype(np.int32)
+        plan = ()
+        if self._planner is not None:
+            x = self.dataset.data["x"]
+            plan = tuple(self._planner.plan(rng, W * B, x.shape[1], x.shape[2]))
+        return clients.astype(np.int32), flat.reshape(W, B), plan
+
     def epoch(self, epoch_idx: int):
         steps = self.steps_per_epoch()
         base = epoch_idx * steps
         for s in range(steps):
             yield self.sample_round(base + s)
+
+    def epoch_indices(self, epoch_idx: int):
+        steps = self.steps_per_epoch()
+        base = epoch_idx * steps
+        for s in range(steps):
+            yield self.sample_round_indices(base + s)
+
+
+def prefetch(it: Iterable, depth: int = 2) -> Iterator:
+    """Run ``it`` in a background thread, ``depth`` items ahead.
+
+    The host-side batch assembly (sampler gather + augment — C++ with the
+    GIL released, or numpy which also drops the GIL inside vectorized ops)
+    then overlaps the device round: the analog of the reference's
+    DataLoader worker processes feeding the GPU train loop. Exceptions in
+    the producer re-raise at the consuming site; if the CONSUMER stops
+    early (exception mid-epoch, generator close), the producer notices via
+    the stop flag within one put-timeout and exits instead of blocking on
+    the bounded queue forever."""
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+            _put(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            _put(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

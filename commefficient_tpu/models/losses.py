@@ -31,15 +31,21 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarr
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def classification_loss(apply_fn):
+def classification_loss(apply_fn, prep=None):
     """Build the cv ``loss_fn``: batch = {"x": [B,H,W,C], "y": [B]}.
 
     Returns (mean CE, {"correct": #correct, "count": B}) — the worker eval
     path's metrics (fed_worker.py ~L290-340).
+
+    ``prep`` maps the raw batch images on DEVICE before the model (e.g.
+    ``data.cifar.device_normalizer``: uint8 -> normalized float32). Keeping
+    batches uint8 until this point quarters the host->TPU transfer — the
+    train loop's measured bottleneck through a tunneled TPU.
     """
 
     def loss_fn(params, batch, rng=None):
-        logits = apply_fn(params, batch["x"])
+        x = batch["x"] if prep is None else prep(batch["x"])
+        logits = apply_fn(params, x)
         loss = softmax_cross_entropy(logits, batch["y"])
         mask = batch["y"] != IGNORE_INDEX  # padded eval rows carry -100
         correct = jnp.sum(

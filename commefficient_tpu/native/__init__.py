@@ -1,0 +1,184 @@
+"""Native (C++) runtime kernels, loaded via ctypes.
+
+The reference gets its data-path speed from libtorch's native DataLoader
+workers (SURVEY.md §2 L4); this package is the TPU build's first-party
+equivalent: small C++ kernels for the host-side work that sits between the
+federated sampler and ``jax.device_put`` — fused gather+augment batch
+assembly (fedloader.cc). ctypes releases the GIL for the duration of each
+call, so under the sampler's prefetch thread the host batch assembly
+overlaps the TPU round.
+
+The library is compiled on first use with the baked-in ``g++`` (no
+pip/pybind11 — plain ``-shared -fPIC``, see ENVIRONMENT constraints) and
+cached next to the source; every entry point has a pure-numpy fallback, so
+the framework runs unchanged where a toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fedloader.cc")
+_LIB_PATH = os.path.join(_DIR, "libfedloader.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
+def _compile() -> bool:
+    flag_sets = [
+        ["-O3", "-march=native", "-fopenmp"],
+        ["-O3", "-fopenmp"],
+        ["-O3"],
+    ]
+    for flags in flag_sets:
+        cmd = ["g++", *flags, "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            return False
+        if r.returncode == 0:
+            return True
+    return False
+
+
+def _bind(path: str):
+    lib = ctypes.CDLL(path)
+    for name, ptr in (
+        ("fedloader_gather_augment", _F32P),
+        ("fedloader_gather_augment_u8", _U8P),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ptr, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            _I64P, ctypes.c_int64,
+            _I32P, _I32P, _U8P, _I32P, _I32P,
+            ctypes.c_int, ctypes.c_int, _F32P, ptr,
+        ]
+        fn.restype = None
+    lib.fedloader_gather_rows.argtypes = [
+        ctypes.c_char_p, _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.fedloader_gather_rows.restype = None
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it if needed; None when unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        stale = not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        )
+        if stale and not _compile():
+            _build_failed = True
+            return None
+        try:
+            _lib = _bind(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def gather_augment(
+    data: np.ndarray,
+    idx: np.ndarray,
+    plan=None,
+    *,
+    pad: int = 4,
+    cut_half: int = 4,
+    fill: Optional[np.ndarray] = None,
+) -> Optional[np.ndarray]:
+    """out[i] = augment(data[idx[i]]) via the native kernel.
+
+    ``data`` is [N, H, W, C] float32 or uint8 (the training pipeline ships
+    uint8 — 4x less host->device traffic). ``plan`` is an AugmentPlan
+    (ys/xs/flips/cys/cxs arrays, see data.cifar.CifarAugment) or None for a
+    pure gather. ``fill`` is the [C] cutout fill in source-dtype scale
+    (None = zeros; pipelines fill the dataset mean for uint8 — see
+    CifarAugment). Returns None when the native library is unavailable
+    (callers fall back to numpy).
+    """
+    lib = load()
+    if lib is None or data.ndim != 4:
+        return None
+    if data.dtype == np.uint8:
+        fn, ptr = lib.fedloader_gather_augment_u8, _U8P
+    elif data.dtype == np.float32:
+        fn, ptr = lib.fedloader_gather_augment, _F32P
+    else:
+        return None
+    data = np.ascontiguousarray(data)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = int(idx.shape[0])
+    _, h, w, c = data.shape
+    out = np.empty((n, h, w, c), data.dtype)
+    if plan is None:
+        null32, null8 = _I32P(), _U8P()
+        args = (null32, null32, null8, null32, null32, 0, 0, _F32P())
+    else:
+        ys = np.ascontiguousarray(plan.ys, np.int32)
+        xs = np.ascontiguousarray(plan.xs, np.int32)
+        flips = np.ascontiguousarray(plan.flips, np.uint8)
+        cys = np.ascontiguousarray(plan.cys, np.int32)
+        cxs = np.ascontiguousarray(plan.cxs, np.int32)
+        fill_arr = (
+            np.zeros((c,), np.float32)
+            if fill is None
+            else np.ascontiguousarray(np.broadcast_to(fill, (c,)), dtype=np.float32)
+        )
+        args = (
+            ys.ctypes.data_as(_I32P), xs.ctypes.data_as(_I32P),
+            flips.ctypes.data_as(_U8P),
+            cys.ctypes.data_as(_I32P), cxs.ctypes.data_as(_I32P),
+            pad, cut_half, fill_arr.ctypes.data_as(_F32P),
+        )
+    fn(
+        data.ctypes.data_as(ptr), data.shape[0], h, w, c,
+        idx.ctypes.data_as(_I64P), n, *args,
+        out.ctypes.data_as(ptr),
+    )
+    return out
+
+
+def gather_rows(data: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
+    """out[i] = data[idx[i]] for any fixed-row-size array; None = no lib."""
+    lib = load()
+    if lib is None or data.dtype == object:
+        return None
+    data = np.ascontiguousarray(data)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    n = int(idx.shape[0])
+    row_bytes = int(data.dtype.itemsize) * (
+        int(np.prod(data.shape[1:], dtype=np.int64)) if data.ndim > 1 else 1
+    )
+    out = np.empty((n,) + data.shape[1:], data.dtype)
+    lib.fedloader_gather_rows(
+        data.ctypes.data_as(ctypes.c_char_p), idx.ctypes.data_as(_I64P), n,
+        row_bytes, out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out

@@ -1,0 +1,116 @@
+// Native data-loader kernel: fused gather + cifar10-fast augmentation.
+//
+// The reference's data path leans on torch's DataLoader, whose worker pool
+// and collation run in libtorch's native code (SURVEY.md §2 L4 — the
+// framework itself ships no first-party native files, the speed comes from
+// the library). This is the TPU build's equivalent: the per-round batch
+// assembly — gather W*B sample rows by index, reflect-pad(4) + random
+// crop(HxW) + horizontal flip + cutout(2*cut_half) — as one cache-friendly
+// OpenMP pass over the source array, called from Python via ctypes (the
+// GIL is released for the duration of the call, so it overlaps the TPU
+// step under the sampler's prefetch thread).
+//
+// Semantics contract: bit-identical float32 output to the vectorized numpy
+// path in commefficient_tpu/data/cifar.py (pure copies and zeroing — no
+// arithmetic), pinned by tests/test_native_loader.py.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// numpy pad(mode="reflect") index map: no edge repeat.
+inline int reflect(int t, int n) {
+  if (t < 0) return -t;
+  if (t >= n) return 2 * n - 2 - t;
+  return t;
+}
+
+template <typename T>
+void gather_augment_impl(const T* data, int H, int W, int C,
+                         const int64_t* idx, int64_t n, const int32_t* ys,
+                         const int32_t* xs, const uint8_t* flips,
+                         const int32_t* cys, const int32_t* cxs, int pad,
+                         int cut_half, const float* fill, T* out) {
+  const int64_t img = (int64_t)H * W * C;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const T* src = data + idx[i] * img;
+    T* dst = out + i * img;
+    if (ys == nullptr) {
+      std::memcpy(dst, src, (size_t)img * sizeof(T));
+      continue;
+    }
+    const int y0 = ys[i] - pad;
+    const int x0 = xs[i] - pad;
+    const bool fl = flips[i] != 0;
+    const int cy0 = cys[i] - cut_half, cy1 = cys[i] + cut_half;
+    const int cx0 = cxs[i] - cut_half, cx1 = cxs[i] + cut_half;
+    for (int r = 0; r < H; ++r) {
+      const T* srow = src + (int64_t)reflect(y0 + r, H) * W * C;
+      T* drow = dst + (int64_t)r * W * C;
+      const bool rcut = (r >= cy0 && r < cy1);
+      for (int col = 0; col < W; ++col) {
+        T* dpix = drow + (int64_t)col * C;
+        if (rcut && col >= cx0 && col < cx1) {
+          // cutout fill: per-channel value in source-dtype scale (the
+          // dataset mean for uint8 pipelines — see CifarAugment)
+          for (int ch = 0; ch < C; ++ch)
+            dpix[ch] = fill ? T(fill[ch]) : T(0);
+        } else {
+          // flip happens on the CROPPED image (numpy order: crop, flip,
+          // cutout), so the flipped source column is W-1-col pre-crop.
+          const int jj = fl ? (W - 1 - col) : col;
+          const T* spix = srow + (int64_t)reflect(x0 + jj, W) * C;
+          for (int ch = 0; ch < C; ++ch) dpix[ch] = spix[ch];
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// data: [N, H, W, C] (contiguous), idx: [n] int64 sample rows.
+// out:  [n, H, W, C], same dtype as data.
+// ys/xs: [n] crop offsets in the padded image (0 .. 2*pad).
+// flips: [n] 0/1 horizontal flip. cys/cxs: [n] cutout centers (0 .. H/W).
+// Passing ys == nullptr skips augmentation entirely (pure gather).
+void fedloader_gather_augment(const float* data, int64_t N, int H, int W,
+                              int C, const int64_t* idx, int64_t n,
+                              const int32_t* ys, const int32_t* xs,
+                              const uint8_t* flips, const int32_t* cys,
+                              const int32_t* cxs, int pad, int cut_half,
+                              const float* fill, float* out) {
+  (void)N;
+  gather_augment_impl<float>(data, H, W, C, idx, n, ys, xs, flips, cys, cxs,
+                             pad, cut_half, fill, out);
+}
+
+// uint8 variant: the training pipeline ships batches uint8 end-to-end (the
+// host->device link is the bottleneck; normalization happens on device).
+void fedloader_gather_augment_u8(const uint8_t* data, int64_t N, int H,
+                                 int W, int C, const int64_t* idx, int64_t n,
+                                 const int32_t* ys, const int32_t* xs,
+                                 const uint8_t* flips, const int32_t* cys,
+                                 const int32_t* cxs, int pad, int cut_half,
+                                 const float* fill, uint8_t* out) {
+  (void)N;
+  gather_augment_impl<uint8_t>(data, H, W, C, idx, n, ys, xs, flips, cys,
+                               cxs, pad, cut_half, fill, out);
+}
+
+// Plain indexed gather: out[i, :] = data[idx[i], :], row_elems elements of
+// elem_size bytes each (dtype-agnostic byte copy).
+void fedloader_gather_rows(const char* data, const int64_t* idx, int64_t n,
+                           int64_t row_bytes, char* out) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(out + i * row_bytes, data + idx[i] * row_bytes,
+                (size_t)row_bytes);
+  }
+}
+
+}  // extern "C"

@@ -12,43 +12,41 @@ those are atomic-add/gather at memory bandwidth, but the TPU is a
 contiguous-vector machine with no fast random access (measured on v5e:
 a 50k-element scatter into 6.5M costs ~24 ms — microseconds of matmul).
 
-Blocked design (this module, v3):
-  * Coordinates are split into CHUNKS of ``m``; each chunk owns a private
-    block of ``s`` buckets, so the table has ``c ~= ceil(d/m) * s``
-    columns. Within a chunk, the bucket of a coordinate is a murmur-style
-    hash of its WITHIN-CHUNK OFFSET, shared across chunks — so one static
-    ``[m, s]`` one-hot matrix realizes the whole row as a single
-    ``[nc, m] x [m, s]`` MXU matmul. No scatter, no per-chunk one-hot
-    materialization (v1 generated ``d*s`` one-hot entries on the VPU per
-    row — 30-50x slower than the MXU matmul).
-  * Each row first applies a RIFFLE permutation with a per-row factor f
-    (``reshape(f, L/f).T`` — a pure transpose, a contiguous memory op):
-    a pair of coordinates at distance delta shares a chunk in row f only
-    when delta < m/f or delta lands near a multiple of L/f. Factors climb
-    geometrically to ~nc (see ``_riffle_factors``), so co-chunk partner
-    sets are (near-)disjoint across rows at EVERY distance scale — near
-    pairs separate in the high-factor rows, far pairs in the low-factor
-    rows. Per-row SIGNS (hashed from the ORIGINAL coordinate) make
-    residual collision terms zero-mean.
-  * Estimation is the transposed matmul ``[nc, s] x [s, m]`` (again MXU)
-    plus the inverse riffle, followed by median across rows — no gather.
+Layout (this module, v5 — "banded"):
+  * Coordinates are split into CHUNKS of ``m``. Chunk q hashes its
+    within-chunk offsets into a WINDOW of ``V = band * stride`` buckets
+    starting at ``q * stride`` of the global row, so neighboring chunks'
+    windows OVERLAP and each coordinate's collision pool is V (~5k)
+    buckets, not a private per-chunk pool. One static ``[m, V]`` one-hot
+    realizes a whole row as a single ``[nc, m] x [m, V]`` MXU matmul
+    followed by ``band`` static shifted adds (overlap-add) — no scatter,
+    no gather. Estimation is the windowed view (static slices) and the
+    transposed matmul, then median across rows.
+  * Before any row layout, ONE seed-derived static permutation of
+    ``scramble_block``-sized coordinate blocks (a cheap row-gather)
+    decorrelates parameter structure from chunk structure; each row then
+    applies a distinct-prime RIFFLE (``reshape(f, L/f).T`` transpose) so
+    partner sets differ across rows.
+  * float32 specs force ``Precision.HIGHEST`` on the matmuls — the fast
+    bf16-pass path carries ~2^-8 relative error per bucket, material once
+    the error sketch accumulates mass.
 
-Why the riffle is load-bearing (v2 POSTMORTEM — do not regress): v2
-staggered rows with cyclic rolls plus a strided layout on alternate rows.
-Rolls shift chunk BOUNDARIES but keep neighborhoods intact, so all
-contiguous rows shared the same ~m co-chunk partners per coordinate; with
-only ``s`` buckets per chunk, the SAME partner pair then collided in >= 2
-of r rows orders of magnitude more often than in a classic sketch
-(expected 2-row repeat partners ~ m/s^2 per coordinate vs ~ d/c^2).
-Repeated-partner collisions corrupt the median in a CORRELATED way, and
-FetchSGD's error feedback re-banks and re-extracts the corruption every
-round: measured as exponential divergence on ResNet-9 at paper-scale
-settings (d/c=13, k=d/130, lr 0.4, momentum 0.9) while a classic scatter
-sketch converged under the identical server algebra. With per-row riffles
-the partner sets are disjoint and repeated-partner rates return to
-classic-sketch levels (regression-tested in tests/test_countsketch.py).
-Per-coordinate collision variance is unchanged: ||v_chunk||^2/s ~
-||v||^2/c.
+v3/v4 POSTMORTEM (do not regress to disjoint pools): with per-chunk
+PRIVATE pools (v3 riffles only, v4 + scramble), a coordinate can only
+collide inside its chunk's ~300 buckets. FetchSGD's error sketch
+accumulates STRUCTURED mass (layer-correlated magnitudes, long waits for
+small coordinates), and per-chunk collision noise grows with the hot
+chunks — the extract-and-subtract feedback loop then amplifies phantom
+estimates: measured on ResNet-9 at paper-scale settings (d/c=13, k=d/130,
+lr 0.4, momentum 0.9) as exponential divergence (train loss 459 after 6
+epochs; NaN under several variants), while an EXACT classic scatter
+sketch under identical server algebra converged (acc 0.315). Banding
+restores a classic-grade collision scope at MXU cost: the same config
+converges at acc 0.305 with band=16 (scripts/sketch_lab.py reproduces the
+whole comparison). Single-shot estimate quality was IDENTICAL across
+layouts (recall@k ~0.38 on a real gradient) — only the iterated feedback
+loop separates them; test any future layout change with the lab's
+multi-epoch run, not one-shot properties.
 
 Linearity is the contract that makes federated aggregation exact:
 ``sketch(a) + sketch(b) == sketch(a + b)`` (bit-exact in float32 mode up to
@@ -208,8 +206,46 @@ class CountSketch(NamedTuple):
     seed: int = 42  # hash seed; equal seeds => equal hashes everywhere
     m: Any = None  # chunk size (coords per bucket block); None = adaptive
     dtype: Any = jnp.float32  # matmul dtype; bfloat16 halves time on MXU
+    # Global block-scramble (v4). REAL gradients have correlated
+    # neighborhoods (a conv kernel's coords sit contiguously in the flat
+    # vector with comparable magnitudes). Riffles alone cannot separate
+    # pairs closer than m/nc, so a whole correlated cluster co-chunks in
+    # most rows and collides inside the tiny per-chunk bucket pool with
+    # prob ~cluster/s PER ROW — the median breaks and FetchSGD's feedback
+    # loop amplifies the corruption (measured: ResNet-9 training diverges,
+    # loss 459 after 6 epochs, while a classic scatter sketch on identical
+    # server algebra converges). One static seed-derived permutation of
+    # ``scramble_block``-sized blocks, shared by all rows and applied
+    # before the per-row riffle/chunk layout, scatters any contiguous
+    # cluster uniformly over the chunks: residual same-chunk cluster mass
+    # drops from ~cluster/s to ~block/s in >=3 rows simultaneously with
+    # probability ~(block/s)^3 — classic-grade. Cost: one [nb, block]
+    # row-gather per sketch/estimate (~memcpy at block>=32, unlike the
+    # element-wise full permutation which costs ~50 ms at d=6.5M).
+    # 0 disables (pre-v4 layout).
+    scramble_block: int = 8
+    # Banded buckets (v5). With disjoint per-chunk pools, a coordinate can
+    # only ever collide inside its chunk's s (~300) buckets; FetchSGD's
+    # error sketch accumulates STRUCTURED mass and the feedback loop
+    # measurably diverges at paper-scale d/c even after the scramble and
+    # full-f32 matmuls, while a classic (global-bucket) scatter sketch
+    # converges under identical server algebra. Banding interpolates the
+    # two at MXU cost: chunk q hashes its offsets into a WINDOW of
+    # V = band * stride buckets starting at q * stride, so windows of
+    # neighboring chunks overlap and each coordinate's collision pool
+    # grows 16-64x while the row stays ONE [nc, m] x [m, V] einsum plus
+    # ``band`` static shifted adds (overlap-add; no scatter, no gather).
+    # band=1 reproduces the disjoint-pool v4 layout; cost scales ~linearly
+    # with band (still sub-ms per row at CV scale).
+    band: int = 16
 
     # -- derived static geometry ------------------------------------------
+    @property
+    def d_eff(self) -> int:
+        """Scrambled-space length: d padded to a block multiple."""
+        b = self.scramble_block
+        return _ceil_mult(self.d, b) if b else self.d
+
     @property
     def chunk_m(self) -> int:
         """Chunk size. Adaptive default: grow m (512..32768, powers of 2)
@@ -245,19 +281,28 @@ class CountSketch(NamedTuple):
         return _riffle_factors(self.d, self.chunk_m, self.r)[row]
 
     def _L_row(self, row: int) -> int:
-        """Per-row padded length: smallest multiple of m * factor >= d."""
-        return _ceil_mult(self.d, self.chunk_m * self._factor(row))
+        """Per-row padded length: smallest multiple of m * factor >= d_eff
+        (the scrambled-space length the row layouts actually operate on)."""
+        return _ceil_mult(self.d_eff, self.chunk_m * self._factor(row))
 
     def _nc_row(self, row: int) -> int:
         return self._L_row(row) // self.chunk_m
 
+    def u_row(self, row: int) -> int:
+        """Band width (windows per chunk) for this row, capped by nc."""
+        return max(1, min(self.band or 1, self._nc_row(row)))
+
     def s_row(self, row: int) -> int:
-        """Buckets per chunk for THIS row: targets the full requested c
-        regardless of the row's padding (a heavily padded row must not
-        shrink every other row's bucket pool — the shared-s version of
-        that measurably destabilized the feedback loop)."""
-        raw = max(1, round(self.c / self._nc_row(row)))
+        """Bucket STRIDE per chunk for THIS row: chunk q's window starts at
+        ``q * s_row``; the realized row width is (nc + u - 1) * s_row,
+        targeted at the requested c. (Per-row, so a heavily padded row
+        must not shrink every other row's bucket pool.)"""
+        raw = max(1, round(self.c / (self._nc_row(row) + self.u_row(row) - 1)))
         return max(8, round(raw / 8) * 8)  # nearest multiple of 8
+
+    def V_row(self, row: int) -> int:
+        """Bucket-pool (window) size per chunk: band * stride."""
+        return self.u_row(row) * self.s_row(row)
 
     @property
     def s(self) -> int:
@@ -265,7 +310,10 @@ class CountSketch(NamedTuple):
 
     @property
     def c_actual(self) -> int:
-        return max(self._nc_row(r) * self.s_row(r) for r in range(self.r))
+        return max(
+            (self._nc_row(r) + self.u_row(r) - 1) * self.s_row(r)
+            for r in range(self.r)
+        )
 
     @property
     def table_shape(self) -> tuple[int, int]:
@@ -283,25 +331,70 @@ class CountSketch(NamedTuple):
         return np.uint32(x ^ int(_GOLDEN))
 
     def _row_signs(self, row: int) -> jnp.ndarray:
-        """[d] ±1, hashed from the ORIGINAL coordinate index."""
-        idx = jnp.arange(self.d, dtype=jnp.uint32)
+        """[d_eff] ±1, hashed from the SCRAMBLED-space index (v4: sketching
+        happens in scrambled space; ``_row_cols_signs`` maps an original
+        coordinate to its scrambled position before hashing, so all entry
+        points agree)."""
+        idx = jnp.arange(self.d_eff, dtype=jnp.uint32)
         bits = _mix32(idx, self._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
         return 1.0 - 2.0 * bits.astype(jnp.float32)
 
     def _offset_slots(self, row: int) -> jnp.ndarray:
-        """[m] int32 bucket per within-chunk offset (shared by all chunks)."""
+        """[m] int32 in-window bucket per within-chunk offset (shared by all
+        chunks; chunk q's window starts at ``q * s_row``)."""
         off = jnp.arange(self.chunk_m, dtype=jnp.uint32)
         return (
-            _mix32(off, self._row_key(row)) % jnp.uint32(self.s_row(row))
+            _mix32(off, self._row_key(row)) % jnp.uint32(self.V_row(row))
         ).astype(jnp.int32)
 
     def _row_onehot(self, row: int) -> jnp.ndarray:
-        """[m, s] static one-hot of ``_offset_slots`` — the whole row's hash
+        """[m, V] static one-hot of ``_offset_slots`` — the whole row's hash
         as one small matmul operand."""
         slots = self._offset_slots(row)
         return (
-            slots[:, None] == jnp.arange(self.s_row(row), dtype=jnp.int32)
+            slots[:, None] == jnp.arange(self.V_row(row), dtype=jnp.int32)
         ).astype(self.dtype)
+
+
+@_functools.lru_cache(maxsize=None)
+def _scramble_perms(d_eff: int, block: int, seed: int):
+    """(sperm, inv_sperm) over the d_eff/block blocks: output block j of the
+    scramble reads input block sperm[j]; input block B lands at output
+    position inv_sperm[B]. Seed-derived (equal seeds => equal scramble on
+    every host/device, like the hashes)."""
+    nb = d_eff // block
+    # pure numpy (callable under an active jax trace): same fmix32 rounds
+    key = np.uint32((seed * 2654435761) & 0xFFFFFFFF)
+    x = np.arange(nb, dtype=np.uint32) ^ key
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint32(16)
+        x *= _M1
+        x ^= x >> np.uint32(13)
+        x *= _M2
+        x ^= x >> np.uint32(16)
+    sperm = np.argsort(x, kind="stable").astype(np.int32)
+    inv = np.empty_like(sperm)
+    inv[sperm] = np.arange(nb, dtype=np.int32)
+    return sperm, inv
+
+
+def _scramble(spec: "CountSketch", v: jnp.ndarray) -> jnp.ndarray:
+    """[d] -> [d_eff] scrambled (block-permuted) vector."""
+    b = spec.scramble_block
+    if not b:
+        return v
+    sperm, _ = _scramble_perms(spec.d_eff, b, spec.seed)
+    vp = jnp.pad(v, (0, spec.d_eff - spec.d))
+    return vp.reshape(-1, b)[jnp.asarray(sperm)].reshape(spec.d_eff)
+
+
+def _unscramble(spec: "CountSketch", v_s: jnp.ndarray) -> jnp.ndarray:
+    """[d_eff] scrambled -> [d] original order."""
+    b = spec.scramble_block
+    if not b:
+        return v_s[: spec.d]
+    _, inv = _scramble_perms(spec.d_eff, b, spec.seed)
+    return v_s.reshape(-1, b)[jnp.asarray(inv)].reshape(spec.d_eff)[: spec.d]
 
 
 def _to_layout(spec: "CountSketch", x_d: jnp.ndarray, row: int) -> jnp.ndarray:
@@ -313,7 +406,7 @@ def _to_layout(spec: "CountSketch", x_d: jnp.ndarray, row: int) -> jnp.ndarray:
     contiguous blocks of m. f=1 rows are plain contiguous chunking.
     """
     f, L = spec._factor(row), spec._L_row(row)
-    xp = jnp.pad(x_d, (0, L - spec.d))
+    xp = jnp.pad(x_d, (0, L - spec.d_eff))
     if f > 1:
         xp = xp.reshape(f, L // f).T.reshape(L)
     return xp.reshape(L // spec.chunk_m, spec.chunk_m)
@@ -325,22 +418,58 @@ def _from_layout(spec: "CountSketch", x_chunks: jnp.ndarray, row: int) -> jnp.nd
     xp = x_chunks.reshape(L)
     if f > 1:
         xp = xp.reshape(L // f, f).T.reshape(L)
-    return xp[: spec.d]
+    return xp[: spec.d_eff]
 
 
 def _ceil_mult(x: int, q: int) -> int:
     return -(-x // q) * q
 
 
-def _sketch_one_row(spec: CountSketch, v: jnp.ndarray, row: int) -> jnp.ndarray:
-    sv = _to_layout(spec, v * spec._row_signs(row), row)
+def _overlap_add(spec: CountSketch, O: jnp.ndarray, row: int) -> jnp.ndarray:
+    """[nc, V] per-chunk windows -> flat row via ``band`` shifted adds
+    (chunk q's window covers positions [q*t, q*t + V))."""
+    nc, u, t = spec._nc_row(row), spec.u_row(row), spec.s_row(row)
+    if u == 1:
+        return O.reshape(nc * t)
+    Or = O.reshape(nc, u, t)
+    acc = jnp.zeros((nc + u - 1, t), jnp.float32)
+    for i in range(u):
+        acc = acc.at[i : i + nc].add(Or[:, i, :])
+    return acc.reshape((nc + u - 1) * t)
+
+
+def _overlap_gather(spec: CountSketch, row_vec: jnp.ndarray, row: int) -> jnp.ndarray:
+    """Inverse view: flat row -> [nc, V] per-chunk windows (static slices)."""
+    nc, u, t = spec._nc_row(row), spec.u_row(row), spec.s_row(row)
+    if u == 1:
+        return row_vec[: nc * t].reshape(nc, t)
+    acc = row_vec[: (nc + u - 1) * t].reshape(nc + u - 1, t)
+    return jnp.stack([acc[i : i + nc] for i in range(u)], axis=1).reshape(
+        nc, u * t
+    )
+
+
+def _sketch_one_row(spec: CountSketch, v_s: jnp.ndarray, row: int) -> jnp.ndarray:
+    # v_s is already in scrambled space ([d_eff]); signs are scrambled-keyed
+    sv = _to_layout(spec, v_s * spec._row_signs(row), row)
+    # HIGHEST precision is LOAD-BEARING for float32 specs: the default
+    # (fast bf16-pass) matmul carries ~2^-8 RELATIVE error on every bucket
+    # sum, and FetchSGD's error sketch grows to ||S_e|| >> ||g|| — 0.4% of
+    # a bucket's accumulated mass eventually exceeds real gradient
+    # coordinates, so estimates drown in cast noise, phantom coordinates
+    # get extracted and re-banked, and training diverges (measured: loss
+    # 459 after 6 ResNet-9 epochs at paper-scale d/c=13; an exact-f32
+    # segment-sum sketch under identical server algebra converges). bf16
+    # specs opt into the noise explicitly.
     out = jnp.einsum(
         "cm,ms->cs",
         sv.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST
+                   if spec.dtype == jnp.float32 else None),
     )
-    out = out.reshape(spec._nc_row(row) * spec.s_row(row))
+    out = _overlap_add(spec, out, row)
     return jnp.pad(out, (0, spec.c_actual - out.shape[0]))
 
 
@@ -348,9 +477,10 @@ def sketch_vec(spec: CountSketch, v: jnp.ndarray) -> jnp.ndarray:
     """Sketch a dense [d] vector into an [r, c_actual] table.
 
     Equivalent of ``CSVec.accumulateVec`` (csvec.py ~L120-160) applied to a
-    fresh table. Linear: ``sketch_vec(a+b) == sketch_vec(a)+sketch_vec(b)``.
+    fresh table. Linear: ``sketch_vec(a+b) == sketch_vec(a)+sketch_vec(b)``
+    (the scramble and layouts are fixed permutations, the matmul is linear).
     """
-    v = v.astype(jnp.float32)
+    v = _scramble(spec, v.astype(jnp.float32))  # ONE block-gather, all rows
     return jnp.stack([_sketch_one_row(spec, v, r) for r in range(spec.r)])
 
 
@@ -361,15 +491,17 @@ def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp
 
 
 def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
-    nc_r = spec._nc_row(row)
-    s_r = spec.s_row(row)
-    tab = table_row[: nc_r * s_r].reshape(nc_r, s_r)
+    tab = _overlap_gather(spec, table_row, row)
     est = jnp.einsum(
         "cs,ms->cm",
         tab.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST
+                   if spec.dtype == jnp.float32 else None),
     )
+    # scrambled-space estimate [d_eff]; estimate_all unscrambles after the
+    # median so the block-gather happens once, not once per row
     return _from_layout(spec, est, row) * spec._row_signs(row)
 
 
@@ -378,31 +510,46 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
 
     ``CSVec._findAllValues`` analog (csvec.py ~L190-260): per row, gather
     each coordinate's bucket value times sign (here: transposed matmul),
-    then median across the r estimates.
+    then median across the r estimates (in scrambled space), then ONE
+    block-gather back to original coordinate order.
     """
     ests = jnp.stack(
         [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
     )
-    return jnp.median(ests, axis=0)[: spec.d]
+    return _unscramble(spec, jnp.median(ests, axis=0))
+
+
+def _scrambled_pos(spec: CountSketch, idx: jnp.ndarray) -> jnp.ndarray:
+    """Original coordinate index -> its position in scrambled space."""
+    b = spec.scramble_block
+    if not b:
+        return idx
+    _, inv = _scramble_perms(spec.d_eff, b, spec.seed)
+    inv = jnp.asarray(inv).astype(jnp.uint32)
+    return inv[(idx // jnp.uint32(b)).astype(jnp.int32)] * jnp.uint32(b) + (
+        idx % jnp.uint32(b)
+    )
 
 
 def _row_cols_signs(spec: CountSketch, idx: jnp.ndarray, row: int):
     """(column index, sign) of each ORIGINAL coordinate in ``idx`` for one
-    row — the gather/scatter-side view of the same mapping
-    ``_sketch_one_row`` realizes with riffle + chunk layout + one-hot
-    matmul."""
+    row — the gather/scatter-side view of the same mapping ``sketch_vec``
+    realizes with scramble + riffle + chunk layout + one-hot matmul."""
     idx = idx.astype(jnp.uint32)
+    spos = _scrambled_pos(spec, idx)
     f, L = spec._factor(row), spec._L_row(row)
     G = jnp.uint32(L // f)
-    # riffled index of original coordinate p: (p mod G) * f + p // G
-    pos = (idx % G) * jnp.uint32(f) + idx // G
+    # riffled index of scrambled position p: (p mod G) * f + p // G
+    pos = (spos % G) * jnp.uint32(f) + spos // G
     chunk = (pos // jnp.uint32(spec.chunk_m)).astype(jnp.int32)
     off = pos % jnp.uint32(spec.chunk_m)
     s_r = spec.s_row(row)
-    h = (_mix32(off, spec._row_key(row)) % jnp.uint32(s_r)).astype(jnp.int32)
-    # signs are keyed by the ORIGINAL coordinate (applied pre-riffle in
+    h = (
+        _mix32(off, spec._row_key(row)) % jnp.uint32(spec.V_row(row))
+    ).astype(jnp.int32)
+    # signs are keyed by the SCRAMBLED position (applied pre-layout in
     # _sketch_one_row), slots by the within-chunk offset
-    bits = _mix32(idx, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
+    bits = _mix32(spos, spec._row_key(row) ^ _GOLDEN) & jnp.uint32(1)
     sign = 1.0 - 2.0 * bits.astype(jnp.float32)
     return chunk * s_r + h, sign
 

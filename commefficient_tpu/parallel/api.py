@@ -62,6 +62,7 @@ class FederatedSession:
     ):
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(cfg.num_devices)
+        self._loss_fn = loss_fn
         vec, unravel = ravel_params(params)
         self.unravel = unravel
         self.grad_size = int(vec.size)  # args.grad_size analog
@@ -77,6 +78,7 @@ class FederatedSession:
             )
         self.state = init_state(cfg, vec, self.spec)
         self.host_vel = self.host_err = None
+        self._dev_data = self._round_idx_fn = None
         if cfg.offload_client_state:
             if needs_client_vel(cfg):
                 self.host_vel = np.zeros((cfg.num_clients, self.grad_size), np.float32)
@@ -87,6 +89,110 @@ class FederatedSession:
         self._batch_sharding = worker_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
         self._n_mesh_devices = self.mesh.devices.size
+        # Commit the state to the mesh's replicated sharding up front: the
+        # jitted round outputs mesh-sharded arrays, and a first call fed
+        # SingleDeviceSharding inputs compiles a SECOND program whose
+        # donated-output layout then persists — one whole extra XLA compile
+        # (~30s for ResNet-9 through the tunnel, measured) buried in epoch 1.
+        self.state = jax.tree.map(
+            lambda a: jax.device_put(a, self._replicated)
+            if isinstance(a, jnp.ndarray)
+            else a,
+            self.state,
+        )
+
+    # -- device-resident data (TPU-native; ships only indices per round) ---
+    def maybe_attach_data(self, dataset, sampler, augment=None) -> bool:
+        """Attach ``dataset``'s arrays device-resident iff the config allows
+        it, the sampler can drive index-only rounds, and the data fits
+        ``cfg.device_data_max_mb``. The single gate shared by the train
+        entry points — returns True when the index path is active."""
+        if not (
+            self.cfg.device_data
+            and not self.cfg.offload_client_state
+            and sampler.fusable
+            and all(isinstance(v, np.ndarray) for v in dataset.data.values())
+            and sum(v.nbytes for v in dataset.data.values())
+            <= self.cfg.device_data_max_mb * 1_000_000
+        ):
+            return False
+        self.attach_data(dataset.data, augment)
+        return True
+
+    def attach_data(self, data: Dict[str, np.ndarray], augment=None) -> None:
+        """Put the WHOLE training set in device HBM (uint8 images: CIFAR-10
+        is 154 MB) and compile an index-driven round: each call ships only
+        ``[W, B]`` int32 sample indices plus the augmentation plan (~KBs).
+        The gather AND the crop/flip/cutout run inside the jitted round, so
+        the host->device link — the measured bottleneck (~40 MB/s through a
+        TPU tunnel; a float32 CIFAR batch alone cost ~310 ms/round) —
+        carries practically nothing.
+
+        ``augment`` is a plan-based augmenter (data.cifar.CifarAugment) or
+        None. The gathered+augmented batch is bit-identical to the host
+        paths (same plan semantics), so training is unchanged.
+        """
+        if self.cfg.offload_client_state:
+            raise NotImplementedError(
+                "device-resident data + host-offloaded client state is "
+                "contradictory; pick one"
+            )
+        from commefficient_tpu.data.cifar import device_augment
+        from commefficient_tpu.parallel.round import build_round_fn as _brf
+
+        self._dev_data = {
+            k: jax.device_put(jnp.asarray(v), self._replicated)
+            for k, v in data.items()
+        }
+        raw_round = _brf(
+            self.cfg, self._loss_fn, self.unravel, self.mesh, self.spec,
+            _jit=False,
+        )
+        pad = getattr(augment, "pad", 4)
+        cut = getattr(augment, "cut_half", 4)
+        has_aug = augment is not None
+        fill = None
+        if has_aug and "x" in data and hasattr(augment, "_fill"):
+            xh = np.asarray(data["x"])
+            fill = augment._fill(xh.dtype, xh.shape[-1])
+        L = self.cfg.num_local_iters if self.cfg.mode == "fedavg" else 0
+
+        def round_idx_fn(state, data, client_ids, idx, plan, lr):
+            W, B = idx.shape
+            flat = idx.reshape(-1)
+            batch = {}
+            for k, v in data.items():
+                g = v[flat]
+                if k == "x" and has_aug:
+                    g = device_augment(g, *plan, pad=pad, cut_half=cut, fill=fill)
+                batch[k] = g.reshape((W, B) + g.shape[1:])
+            if L:  # fedavg microbatch convention ([W, L, B/L, ...]), any L
+                batch = {
+                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                    for k, v in batch.items()
+                }
+            return raw_round(state, client_ids, batch, lr)
+
+        self._round_idx_fn = jax.jit(round_idx_fn, donate_argnums=(0,))
+
+    def train_round_indices(self, client_ids, idx, plan, lr: float):
+        """Run one round from device-resident data (see ``attach_data``)."""
+        ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
+        idxd = jax.device_put(
+            jnp.asarray(np.asarray(idx, np.int32)), self._batch_sharding
+        )
+        pl = (
+            tuple(
+                jax.device_put(jnp.asarray(np.asarray(a)), self._replicated)
+                for a in plan
+            )
+            if plan
+            else ()
+        )
+        self.state, metrics = self._round_idx_fn(
+            self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr)
+        )
+        return metrics
 
     # -- train ------------------------------------------------------------
     def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray], lr: float):
@@ -133,17 +239,29 @@ class FederatedSession:
         return out
 
     def evaluate(self, batches: Iterable[Dict[str, np.ndarray]]) -> Dict[str, float]:
+        # Dispatch every batch WITHOUT fetching, then stack the per-batch
+        # metric dicts on device and fetch once — a per-batch float() costs
+        # a full tunnel round trip (~100-400 ms) and serialized the whole
+        # val pass (measured 21 s for a 2.5 s eval).
+        outs = []
+        valids = []
+        for b in batches:
+            outs.append(self.eval_fn(self.state.params_vec, self._put_eval_batch(b)))
+            valids.append(float(np.asarray(b["_valid"])))
+        if not outs:
+            return {"loss": float("nan")}
+        from commefficient_tpu.utils.logging import pack_metric_dicts
+
+        names, mat = pack_metric_dicts(outs)
         totals: Dict[str, float] = {}
         n = 0.0
-        for b in batches:
-            out = self.eval_fn(self.state.params_vec, self._put_eval_batch(b))
-            valid = float(b["_valid"])
-            for k, v in out.items():
+        for j, valid in enumerate(valids):
+            for i, k in enumerate(names):
                 # loss_sum/correct/count are already per-row sums; weight any
                 # other (per-batch mean) aux key by the batch's valid rows so
                 # the padded tail batch doesn't bias the average (ADVICE r1).
                 w = 1.0 if k in ("loss_sum", "correct", "count") else valid
-                totals[k] = totals.get(k, 0.0) + w * float(v)
+                totals[k] = totals.get(k, 0.0) + w * float(mat[j, i])
             n += valid
         result = {"loss": totals.get("loss_sum", 0.0) / max(n, 1.0)}
         if "count" in totals and totals["count"] > 0:

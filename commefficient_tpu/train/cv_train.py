@@ -33,6 +33,7 @@ from commefficient_tpu.data import (
     load_fed_cifar100,
     load_fed_emnist,
     load_fed_imagenet,
+    prefetch,
 )
 from commefficient_tpu.models import ResNet9, classification_loss, fixup_resnet50
 from commefficient_tpu.parallel import FederatedSession
@@ -48,7 +49,17 @@ from commefficient_tpu.utils.logging import drain_round_metrics, make_logdir
 
 
 def build_model_and_data(cfg: Config):
-    """Dataset + model for cfg.dataset_name / cfg.model."""
+    """Dataset + model for cfg.dataset_name / cfg.model.
+
+    Image batches stay uint8 on the host (loaders no longer normalize);
+    ``prep`` normalizes ON DEVICE inside the loss — the host->TPU link is
+    the train loop's bottleneck (~40 MB/s measured through the tunnel), so
+    shipping uint8 quarters the per-round transfer.
+    """
+    from commefficient_tpu.data.cifar import CIFAR10_MEAN, CIFAR10_STD, device_normalizer
+    from commefficient_tpu.data.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+    prep = None
     if cfg.dataset_name == "cifar10":
         train, test, real = load_fed_cifar10(
             cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
@@ -56,6 +67,7 @@ def build_model_and_data(cfg: Config):
         sample_shape = (1, 32, 32, 3)
         num_classes = cfg.resolved_num_classes
         augment = augment_batch
+        prep = device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
     elif cfg.dataset_name == "cifar100":
         train, test, real = load_fed_cifar100(
             cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
@@ -63,6 +75,7 @@ def build_model_and_data(cfg: Config):
         sample_shape = (1, 32, 32, 3)
         num_classes = cfg.resolved_num_classes
         augment = augment_batch
+        prep = device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
     elif cfg.dataset_name == "femnist":
         train, test, real = load_fed_emnist(
             cfg.dataset_dir, num_clients=cfg.num_clients, seed=cfg.seed
@@ -81,6 +94,7 @@ def build_model_and_data(cfg: Config):
         sample_shape = (1,) + train.data["x"].shape[1:]
         num_classes = cfg.resolved_num_classes
         augment = None
+        prep = device_normalizer(IMAGENET_MEAN, IMAGENET_STD)
     else:
         raise ValueError(f"unknown dataset {cfg.dataset_name!r}")
 
@@ -91,13 +105,18 @@ def build_model_and_data(cfg: Config):
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
     params = model.init(jax.random.key(cfg.seed), jnp.zeros(sample_shape))
-    loss_fn = classification_loss(model.apply)
+    loss_fn = classification_loss(model.apply, prep=prep)
     return train, test, real, model, params, loss_fn, augment
 
 
 def build_session_and_sampler(cfg: Config, train, params, loss_fn, augment):
     """Session + sampler wiring shared by main() and scripts/accuracy_run.py.
-    (The fedavg microbatch convention lives in Config.sampler_batch_size.)"""
+    (The fedavg microbatch convention lives in Config.sampler_batch_size.)
+
+    When the training set fits ``cfg.device_data_max_mb`` it is attached
+    device-resident (session.attach_data): rounds then ship only sample
+    indices + the augment plan instead of pixel batches — the host->TPU
+    link is the real loop's bottleneck (~40 MB/s through a tunnel)."""
     session = FederatedSession(cfg, params, loss_fn)
     sampler = FedSampler(
         train,
@@ -106,6 +125,7 @@ def build_session_and_sampler(cfg: Config, train, params, loss_fn, augment):
         seed=cfg.seed,
         augment=augment,
     )
+    session.maybe_attach_data(train, sampler, augment)
     return session, sampler
 
 
@@ -152,18 +172,29 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
 
         drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
 
-        for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
+        use_idx = getattr(session, "_dev_data", None) is not None
+        rounds = (
+            prefetch(sampler.epoch_indices(epoch))
+            if use_idx
+            else prefetch(sampler.epoch(epoch))
+        )
+        for round_idx, item in enumerate(rounds):
             if epoch * steps_per_epoch + round_idx < step:
                 continue  # fast-forward within the resumed epoch
-            if cfg.mode == "fedavg":
-                L = cfg.num_local_iters
-                batch = {
-                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                    for k, v in batch.items()
-                }
             lr = float(lr_fn(step))
             profiler.step(step)
-            metrics = session.train_round(client_ids, batch, lr)
+            if use_idx:
+                client_ids, idx, plan = item
+                metrics = session.train_round_indices(client_ids, idx, plan, lr)
+            else:
+                client_ids, batch = item
+                if cfg.mode == "fedavg":
+                    L = cfg.num_local_iters
+                    batch = {
+                        k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                        for k, v in batch.items()
+                    }
+                metrics = session.train_round(client_ids, batch, lr)
             pending.append((step, lr, metrics))
             step += 1
             if checkpointer is not None:

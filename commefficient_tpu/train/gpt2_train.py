@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from commefficient_tpu.data import FedSampler, load_fed_personachat
+from commefficient_tpu.data import FedSampler, load_fed_personachat, prefetch
 from commefficient_tpu.models import (
     GPT2Config,
     GPT2DoubleHeads,
@@ -132,17 +132,28 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
 
         drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
 
-        for round_idx, (client_ids, batch) in enumerate(sampler.epoch(epoch)):
+        use_idx = getattr(session, "_dev_data", None) is not None
+        rounds = (
+            prefetch(sampler.epoch_indices(epoch))
+            if use_idx
+            else prefetch(sampler.epoch(epoch))
+        )
+        for round_idx, item in enumerate(rounds):
             if epoch * steps_per_epoch + round_idx < step:
                 continue  # fast-forward within the resumed epoch
-            if cfg.mode == "fedavg":
-                L = cfg.num_local_iters
-                batch = {
-                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                    for k, v in batch.items()
-                }
             lr = float(lr_fn(step))
-            metrics = session.train_round(client_ids, batch, lr)
+            if use_idx:
+                client_ids, idx, plan = item
+                metrics = session.train_round_indices(client_ids, idx, plan, lr)
+            else:
+                client_ids, batch = item
+                if cfg.mode == "fedavg":
+                    L = cfg.num_local_iters
+                    batch = {
+                        k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                        for k, v in batch.items()
+                    }
+                metrics = session.train_round(client_ids, batch, lr)
             pending.append((step, lr, metrics))
             step += 1
             if checkpointer is not None:
@@ -272,6 +283,8 @@ def main(argv=None, **overrides):
         local_batch_size=cfg.sampler_batch_size,
         seed=cfg.seed,
     )
+    # token arrays live in HBM when they fit; rounds ship only [W, B] indices
+    session.maybe_attach_data(train, sampler)
     writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 

@@ -64,7 +64,14 @@ class Config:
     num_epochs: int = 24
     max_grad_norm: Optional[float] = None
     weight_decay: float = 5e-4
-    momentum_dampening: bool = False  # zero momentum at HH coords after send
+    # Zero momentum at the extracted/transmitted coordinates ("momentum
+    # masking"/dampening). None = AUTO: True for the dense modes
+    # (true_topk/local_topk — the reference's server and worker helpers
+    # zero velocity at sent coords; measured: unmasked momentum overshoots
+    # and true_topk decays from 0.47 to 0.10 over 24 epochs), False for
+    # sketch (FetchSGD Alg 1 does not mask sketched momentum, and masking
+    # via noisy estimates destabilizes — see round.py warning).
+    momentum_dampening: Optional[bool] = None
 
     # --- model / dataset (reference: --model, --dataset_name,
     # --dataset_dir) ---
@@ -98,6 +105,15 @@ class Config:
     # leave it off there). Ignored (vmap path used) for fedavg/local_topk
     # or when local momentum / local error / clip / DP noise is on.
     fuse_clients: bool = False
+
+    # Keep the whole (uint8) training set resident in device HBM and ship
+    # only [W, B] sample indices + the augmentation plan each round (~KBs
+    # instead of the pixel batch). The host->device link is the real train
+    # loop's bottleneck on tunneled TPUs (~40 MB/s measured); CIFAR-scale
+    # sets (154 MB) fit HBM trivially. Auto-disabled by cv_train when the
+    # dataset exceeds device_data_max_mb or the mode needs host batches.
+    device_data: bool = True
+    device_data_max_mb: int = 512
 
     # --- memory (TPU-native; SURVEY.md §7 hard-parts) ---
     # Keep [num_clients, D] client momentum/error rows in host RAM and move
@@ -184,8 +200,17 @@ def _add_flags(p: argparse.ArgumentParser) -> None:
                 default=default,
             )
         elif "Optional" in ann or "None" in ann:
-            inner = float if "float" in ann else (int if "int" in ann else str)
-            p.add_argument(name, type=inner, default=default)
+            if "bool" in ann:  # tri-state: None (auto) | true | false
+                p.add_argument(
+                    name,
+                    type=lambda s: s.lower() in ("1", "true", "yes"),
+                    nargs="?",
+                    const=True,
+                    default=default,
+                )
+            else:
+                inner = float if "float" in ann else (int if "int" in ann else str)
+                p.add_argument(name, type=inner, default=default)
         else:
             p.add_argument(name, type=type(default), default=default)
 

@@ -97,6 +97,43 @@ class MetricsWriter:
             self._tb.close()
 
 
+_PACKER_CACHE: dict = {}
+
+
+def pack_metric_dicts(dicts):
+    """Fetch N same-keyed dicts of device scalars as ONE host [N, K] array.
+
+    Everything happens inside a single jitted program: on a tunneled TPU
+    backend every EAGER op costs a full RPC (~25-60 ms measured), so
+    stacking 48 rounds x 3 scalars eagerly took 7-9 s even fully cached,
+    and leaf-wise device_get 56 s — the jitted pack + one fetch is ~0.2 s.
+    Jit caches per (N, key set); train epochs and eval passes have constant
+    N, so each shape compiles once per process.
+
+    Returns (names, mat) with ``mat[j, i] == float(dicts[j][names[i]])``.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    names = tuple(sorted(dicts[0]))
+    key = (len(dicts), names)
+    pack = _PACKER_CACHE.get(key)
+    if pack is None:
+
+        @jax.jit
+        def pack(ms):
+            return jnp.stack(
+                [
+                    jnp.stack([jnp.asarray(m[k], jnp.float32) for k in names])
+                    for m in ms
+                ]
+            )
+
+        _PACKER_CACHE[key] = pack
+    return names, np.asarray(pack(tuple(dicts)))
+
+
 def drain_round_metrics(pending, writer, accumulate) -> None:
     """Fetch buffered per-round DEVICE metrics and clear the buffer.
 
@@ -108,7 +145,11 @@ def drain_round_metrics(pending, writer, accumulate) -> None:
     the common train/loss + lr scalars; per-workload accumulation goes
     through ``accumulate(loss, metrics)``.
     """
-    for s, s_lr, metrics in pending:
+    if not pending:
+        return
+    names, mat = pack_metric_dicts([m for _, _, m in pending])
+    for j, (s, s_lr, _) in enumerate(pending):
+        metrics = {k: mat[j, i] for i, k in enumerate(names)}
         loss = float(metrics["loss"])
         if writer:
             writer.scalar("train/loss", loss, s)

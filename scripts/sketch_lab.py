@@ -1,0 +1,102 @@
+"""Sketch-mode stability lab — quarter-scale ResNet-9 federated training.
+
+The fast iteration loop used to debug FetchSGD-mode convergence (r2): a
+width-32 ResNet-9 (D ~= 1.6M) on the synthetic CIFAR stand-in with
+paper-scale RATIOS (c = D/13, k = D/130), 6 epochs of the real pipeline
+(device-resident data path), ~90 s per run on one chip.
+
+    python scripts/sketch_lab.py --lr_scale 0.2 --virtual_momentum 0.9 \
+        [--scramble_block 8] [--num_rows 5] [--num_epochs 6]
+
+Findings this script produced (2026-07-30, see ops/countsketch.py and
+round.py docstrings): divergence at lr 0.4 + rho 0.9 reproduces with an
+EXACT classic scatter sketch — it is a property of topk-EF burst dynamics
+on flat synthetic gradients, not only of the sketch layout; the layout
+(v3 -> v4 block-scramble) and matmul precision changes shift the cliff but
+the operating envelope (lr x momentum) is what decides convergence here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import warnings
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    warnings.filterwarnings("ignore")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lr_scale", type=float, default=0.4)
+    ap.add_argument("--virtual_momentum", type=float, default=0.9)
+    ap.add_argument("--num_rows", type=int, default=5)
+    ap.add_argument("--num_epochs", type=int, default=6)
+    ap.add_argument("--pivot_epoch", type=int, default=2)
+    ap.add_argument("--width", type=int, default=32)
+    ap.add_argument("--c_div", type=int, default=13, help="c = D / c_div")
+    ap.add_argument("--k_div", type=int, default=130, help="k = D / k_div")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from commefficient_tpu.data import FedSampler, augment_batch
+    from commefficient_tpu.data.cifar import (
+        CIFAR10_MEAN, CIFAR10_STD, _synthetic_cifar, device_normalizer,
+    )
+    from commefficient_tpu.data.fed_dataset import FedDataset
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.parallel import FederatedSession
+    from commefficient_tpu.utils.config import Config
+    from commefficient_tpu.utils.schedule import piecewise_linear_lr
+
+    model = ResNet9(num_classes=10, width=args.width)
+    params = model.init(jax.random.key(42), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(
+        model.apply, prep=device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
+    )
+    D = ravel_pytree(params)[0].size
+    C, K = D // args.c_div, D // args.k_div
+    print(f"D={D} c={C} k={K} lr={args.lr_scale} rho={args.virtual_momentum}")
+
+    tr_raw, te_raw = _synthetic_cifar(10)
+    train = FedDataset(dict(tr_raw), 16, seed=42)
+    test = FedDataset(dict(te_raw), 1, seed=42)
+
+    cfg = Config(
+        mode="sketch", error_type="virtual",
+        virtual_momentum=args.virtual_momentum,
+        k=K, num_rows=args.num_rows, num_cols=C, topk_method="threshold",
+        fuse_clients=True, num_clients=16, num_workers=8, num_devices=1,
+        local_batch_size=64, weight_decay=5e-4, seed=42,
+        num_epochs=args.num_epochs, lr_scale=args.lr_scale,
+        pivot_epoch=args.pivot_epoch,
+    )
+    session = FederatedSession(cfg, params, loss_fn)
+    print(f"spec: band={session.spec.band} V={session.spec.V_row(0)} "
+          f"s={session.spec.s} scramble_block={session.spec.scramble_block}")
+    sampler = FedSampler(train, num_workers=8, local_batch_size=64, seed=42,
+                         augment=augment_batch)
+    session.maybe_attach_data(train, sampler, augment_batch)
+    steps = sampler.steps_per_epoch()
+    lr_fn = partial(piecewise_linear_lr, steps_per_epoch=steps,
+                    pivot_epoch=cfg.pivot_epoch, num_epochs=cfg.num_epochs,
+                    lr_scale=cfg.lr_scale)
+    step = 0
+    for ep in range(cfg.num_epochs):
+        for ids, idx, plan in sampler.epoch_indices(ep):
+            m = session.train_round_indices(ids, idx, plan, float(lr_fn(step)))
+            step += 1
+        print(f"  ep{ep + 1}: train_loss={float(np.asarray(m['loss'])):.4f}",
+              flush=True)
+    val = session.evaluate(test.eval_batches(512))
+    print(f"== acc={val.get('accuracy'):.4f} loss={val['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    main()
