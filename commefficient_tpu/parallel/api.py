@@ -75,6 +75,7 @@ class FederatedSession:
                 num_blocks=cfg.num_blocks,
                 seed=cfg.seed,
                 dtype=jnp.bfloat16 if cfg.sketch_dtype == "bfloat16" else jnp.float32,
+                band=cfg.sketch_band,
             )
         self.state = init_state(cfg, vec, self.spec)
         self.host_vel = self.host_err = None

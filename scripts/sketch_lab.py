@@ -5,15 +5,19 @@ width-32 ResNet-9 (D ~= 1.6M) on the synthetic CIFAR stand-in with
 paper-scale RATIOS (c = D/13, k = D/130), 6 epochs of the real pipeline
 (device-resident data path), ~90 s per run on one chip.
 
-    python scripts/sketch_lab.py --lr_scale 0.2 --virtual_momentum 0.9 \
-        [--scramble_block 8] [--num_rows 5] [--num_epochs 6]
+    python scripts/sketch_lab.py --lr_scale 0.4 --virtual_momentum 0.9 \
+        [--band 16] [--num_rows 5] [--num_epochs 6]
 
-Findings this script produced (2026-07-30, see ops/countsketch.py and
-round.py docstrings): divergence at lr 0.4 + rho 0.9 reproduces with an
-EXACT classic scatter sketch — it is a property of topk-EF burst dynamics
-on flat synthetic gradients, not only of the sketch layout; the layout
-(v3 -> v4 block-scramble) and matmul precision changes shift the cliff but
-the operating envelope (lr x momentum) is what decides convergence here.
+Findings this script produced (2026-07-30, full postmortem in
+ops/countsketch.py): at lr 0.4 + rho 0.9 the disjoint-pool layouts (v3
+riffles, v4 + scramble) diverge (train loss 459 / NaN by epoch 6) while an
+EXACT classic scatter sketch under identical server algebra converges to
+acc 0.315 — and the v5 BANDED layout matches classic (acc 0.305 at
+band=16, 0.333 at band=8). Under a constant-lr offline loop everything
+including classic eventually destabilizes (topk-EF burst dynamics on flat
+synthetic gradients), so always validate with this script's real
+triangular-schedule pipeline, and with a multi-epoch run — single-shot
+estimate quality measured IDENTICAL across layouts (recall@k ~0.38).
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ def main():
     ap.add_argument("--num_epochs", type=int, default=6)
     ap.add_argument("--pivot_epoch", type=int, default=2)
     ap.add_argument("--width", type=int, default=32)
+    ap.add_argument("--band", type=int, default=16)
     ap.add_argument("--c_div", type=int, default=13, help="c = D / c_div")
     ap.add_argument("--k_div", type=int, default=130, help="k = D / k_div")
     args = ap.parse_args()
@@ -72,6 +77,7 @@ def main():
         mode="sketch", error_type="virtual",
         virtual_momentum=args.virtual_momentum,
         k=K, num_rows=args.num_rows, num_cols=C, topk_method="threshold",
+        sketch_band=args.band,
         fuse_clients=True, num_clients=16, num_workers=8, num_devices=1,
         local_batch_size=64, weight_decay=5e-4, seed=42,
         num_epochs=args.num_epochs, lr_scale=args.lr_scale,
