@@ -50,7 +50,13 @@ multi-epoch run, not one-shot properties.
 Linearity is the contract that makes federated aggregation exact:
 ``sketch(a) + sketch(b) == sketch(a + b)`` (bit-exact in float32 mode up to
 float addition order), so ``lax.psum`` of worker tables IS the sketch of the
-summed update.
+summed update. Precision caveat: on TPU the matmul paths run at the default
+(bf16-pass) matmul precision, so matmul-path results (sketch_vec,
+estimate_all) carry ~2^-8 RELATIVE rounding vs the exact gather/scatter
+paths (sketch_sparse, estimate_at) — exact on CPU, ~4e-3 relative on TPU.
+Training is insensitive (accumulate and EF-subtract share the matmul path,
+so the rounding cancels to first order; lab-verified), and forcing
+Precision.HIGHEST costs 3x for no accuracy change.
 
 ``num_blocks`` from the reference API (hash-reuse chunking for GPU memory,
 csvec.py ~L60-100) is accepted for config parity but unused: the blocked
@@ -562,8 +568,10 @@ def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.
 def sketch_sparse(spec: CountSketch, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
     """Sketch a k-sparse vector given as (indices [k], values [k]).
 
-    Identical result to ``sketch_vec`` of the dense materialization (same
-    hash mapping, see ``_row_cols_signs``) via O(r·k) scatter-adds. NB on
+    Same hash mapping as ``sketch_vec`` of the dense materialization (see
+    ``_row_cols_signs``) via O(r·k) scatter-adds — bit-identical on CPU;
+    on TPU the dense path's matmul carries ~2^-8 relative rounding (module
+    docstring precision caveat). NB on
     TPU a dense ``sketch_vec`` matmul often beats this for k ≳ 10^4 —
     scatter is the slow path on this hardware; this exists for small-k and
     host-side uses. Coordinates may repeat; repeats accumulate.

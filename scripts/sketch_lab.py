@@ -12,8 +12,9 @@ Findings this script produced (2026-07-30, full postmortem in
 ops/countsketch.py): at lr 0.4 + rho 0.9 the disjoint-pool layouts (v3
 riffles, v4 + scramble) diverge (train loss 459 / NaN by epoch 6) while an
 EXACT classic scatter sketch under identical server algebra converges to
-acc 0.315 — and the v5 BANDED layout matches classic (acc 0.305 at
-band=16, 0.333 at band=8). Under a constant-lr offline loop everything
+acc 0.315 — and the v5 BANDED layout matches classic (acc 0.340 at band=16
+and 0.333 at band=8 with the shipped default matmul precision; 0.305 at
+band=16 under the since-removed Precision.HIGHEST forcing). Under a constant-lr offline loop everything
 including classic eventually destabilizes (topk-EF burst dynamics on flat
 synthetic gradients), so always validate with this script's real
 triangular-schedule pipeline, and with a multi-epoch run — single-shot
@@ -85,7 +86,8 @@ def main():
     )
     session = FederatedSession(cfg, params, loss_fn)
     print(f"spec: band={session.spec.band} V={session.spec.V_row(0)} "
-          f"s={session.spec.s} scramble_block={session.spec.scramble_block}")
+          f"s={session.spec.s} scramble_block={session.spec.scramble_block} "
+          f"c_actual={session.spec.c_actual}")
     sampler = FedSampler(train, num_workers=8, local_batch_size=64, seed=42,
                          augment=augment_batch)
     session.maybe_attach_data(train, sampler, augment_batch)
