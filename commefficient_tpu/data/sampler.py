@@ -136,6 +136,16 @@ class FedSampler:
             self.dataset.num_clients, size=self.num_workers, replace=False
         )
         W, B = self.num_workers, self.local_batch_size
+        # loud guard for the int32 narrowing below: a >= 2^31-row dataset
+        # would silently wrap sample indices (ADVICE r2). (_fused_round keeps
+        # int64 on the host path; the device path ships int32 on purpose —
+        # half the bytes through the ~40 MB/s tunnel.)
+        if len(self.dataset) >= 2**31:
+            raise OverflowError(
+                f"dataset has {len(self.dataset)} rows; the device-resident "
+                "index path ships int32 sample indices — use the host batch "
+                "path for datasets >= 2^31 rows"
+            )
         flat = np.concatenate(
             [self.dataset.client_batch_indices(int(c), B, rng) for c in clients]
         ).astype(np.int32)

@@ -24,11 +24,21 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarr
     ``torch.nn.CrossEntropyLoss(ignore_index=-100)`` semantics used by the
     GPT-2 LM head in the reference.
     """
+    s, n = softmax_cross_entropy_sum(logits, labels)
+    return s / jnp.maximum(n, 1.0)
+
+
+def softmax_cross_entropy_sum(logits: jnp.ndarray, labels: jnp.ndarray):
+    """(sum of NLL over non-ignored positions, #non-ignored positions).
+
+    The sum/count pair lets callers weight correctly across ragged batches
+    (a per-batch MEAN weighted by batch count biases the result when the
+    final batch is partially padded — VERDICT r2 item 6)."""
     mask = (labels != IGNORE_INDEX).astype(jnp.float32)
     safe = jnp.where(labels == IGNORE_INDEX, 0, labels)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(nll * mask), jnp.sum(mask)
 
 
 def classification_loss(apply_fn, prep=None):
@@ -73,9 +83,10 @@ def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0)
             mc_token_ids=batch["mc_token_ids"],
         )
         # next-token shift, as in the reference workload
-        lm_loss = softmax_cross_entropy(
+        lm_sum, tok_count = softmax_cross_entropy_sum(
             lm_logits[..., :-1, :], batch["lm_labels"][..., 1:]
         )
+        lm_loss = lm_sum / jnp.maximum(tok_count, 1.0)
         mc_loss = softmax_cross_entropy(mc_logits, batch["mc_labels"])
         loss = lm_coef * lm_loss + mc_coef * mc_loss
         mc_mask = batch["mc_labels"] != IGNORE_INDEX  # padded eval rows
@@ -88,6 +99,11 @@ def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0)
             "mc_loss": mc_loss,
             "correct": mc_correct,
             "count": count,
+            # token-weighted pair: exact nll under ragged final batches
+            # (VERDICT r2 item 6) — evaluate() sums *_sum/*_count keys
+            # instead of row-weighting them
+            "lm_loss_sum": lm_sum,
+            "token_count": tok_count,
         }
 
     return loss_fn

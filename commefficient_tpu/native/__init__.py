@@ -39,20 +39,30 @@ _U8P = ctypes.POINTER(ctypes.c_uint8)
 
 
 def _compile() -> bool:
+    # Build to a per-process temp path and os.replace() into place: a second
+    # process (multi-host launch, parallel pytest) dlopening a partially
+    # written .so would fail or crash; rename on the same filesystem is
+    # atomic (ADVICE r2).
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     flag_sets = [
         ["-O3", "-march=native", "-fopenmp"],
         ["-O3", "-fopenmp"],
         ["-O3"],
     ]
-    for flags in flag_sets:
-        cmd = ["g++", *flags, "-shared", "-fPIC", "-o", _LIB_PATH, _SRC]
-        try:
-            r = subprocess.run(cmd, capture_output=True, timeout=120)
-        except (FileNotFoundError, subprocess.TimeoutExpired):
-            return False
-        if r.returncode == 0:
-            return True
-    return False
+    try:
+        for flags in flag_sets:
+            cmd = ["g++", *flags, "-shared", "-fPIC", "-o", tmp, _SRC]
+            try:
+                r = subprocess.run(cmd, capture_output=True, timeout=120)
+            except (FileNotFoundError, subprocess.TimeoutExpired):
+                return False
+            if r.returncode == 0:
+                os.replace(tmp, _LIB_PATH)
+                return True
+        return False
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _bind(path: str):
@@ -134,6 +144,7 @@ def gather_augment(
         return None
     data = np.ascontiguousarray(data)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    _check_idx(idx, data.shape[0])
     n = int(idx.shape[0])
     _, h, w, c = data.shape
     out = np.empty((n, h, w, c), data.dtype)
@@ -165,6 +176,18 @@ def gather_augment(
     return out
 
 
+def _check_idx(idx: np.ndarray, n_rows: int) -> None:
+    """The C kernels do no bounds checking ((void)N in fedloader.cc) — a
+    corrupt or negative index would be a silent out-of-bounds READ in the
+    OpenMP copy loop. Validate on the Python side instead (ADVICE r2);
+    numpy's min/max over an index batch is noise next to the copy itself."""
+    if idx.size and (int(idx.min()) < 0 or int(idx.max()) >= n_rows):
+        raise IndexError(
+            f"gather index out of range: [{int(idx.min())}, {int(idx.max())}] "
+            f"vs {n_rows} data rows"
+        )
+
+
 def gather_rows(data: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
     """out[i] = data[idx[i]] for any fixed-row-size array; None = no lib."""
     lib = load()
@@ -172,6 +195,7 @@ def gather_rows(data: np.ndarray, idx: np.ndarray) -> Optional[np.ndarray]:
         return None
     data = np.ascontiguousarray(data)
     idx = np.ascontiguousarray(idx, dtype=np.int64)
+    _check_idx(idx, data.shape[0])
     n = int(idx.shape[0])
     row_bytes = int(data.dtype.itemsize) * (
         int(np.prod(data.shape[1:], dtype=np.int64)) if data.ndim > 1 else 1

@@ -38,8 +38,14 @@ def topk_threshold_dense(v: jnp.ndarray, k: int, iters: int = 32) -> jnp.ndarray
 
     Selects ``|v| >= t`` for the smallest tested ``t`` whose selection count
     is ≤ k, so the result has AT MOST k nonzeros; exact ties at the
-    threshold are dropped rather than arbitrarily broken (on float gradient
-    vectors this loses at most a handful of coordinates vs. exact top-k).
+    threshold are dropped rather than arbitrarily broken. MEASURED
+    (scripts/topk_tie_loss.py, r3): on real float32 ResNet-9 round
+    gradients at d=6.5M, k=50k — fresh and partially trained, both
+    synthetic variants — the dropped count is exactly 0 and the l1 mass
+    gap vs ``lax.top_k`` is 0.0; float32 gradient magnitudes essentially
+    never tie within the 2^-32-relative bisection resolution. (Re-measure
+    with that script before top-k'ing low-precision vectors, where ties
+    are plausible.)
     """
     mag = jnp.abs(v)
     hi0 = jnp.max(mag)

@@ -27,7 +27,12 @@ import numpy as np
 
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import ravel_params
-from commefficient_tpu.parallel.mesh import make_mesh, worker_sharding, replicated
+from commefficient_tpu.parallel.mesh import (
+    WORKERS,
+    make_mesh,
+    replicated,
+    worker_sharding,
+)
 from commefficient_tpu.parallel.round import (
     FedState,
     build_eval_fn,
@@ -61,7 +66,11 @@ class FederatedSession:
         mask_batch: Callable = mask_classification,
     ):
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else make_mesh(cfg.num_devices)
+        self.mesh = (
+            mesh
+            if mesh is not None
+            else make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis)
+        )
         self._loss_fn = loss_fn
         vec, unravel = ravel_params(params)
         self.unravel = unravel
@@ -89,7 +98,12 @@ class FederatedSession:
         self.eval_fn = build_eval_fn(eval_loss_fn or loss_fn, unravel, mask_batch)
         self._batch_sharding = worker_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
-        self._n_mesh_devices = self.mesh.devices.size
+        # eval batches shard their rows over the WORKERS axis only (they
+        # stay replicated over any model/seq axes), so row divisibility is
+        # against the workers-axis size, not the whole mesh
+        self._n_mesh_devices = dict(
+            zip(self.mesh.axis_names, self.mesh.devices.shape)
+        )[WORKERS]
         # Commit the state to the mesh's replicated sharding up front: the
         # jitted round outputs mesh-sharded arrays, and a first call fed
         # SingleDeviceSharding inputs compiles a SECOND program whose
@@ -254,22 +268,32 @@ class FederatedSession:
         from commefficient_tpu.utils.logging import pack_metric_dicts
 
         names, mat = pack_metric_dicts(outs)
+        sum_keys = {
+            k for k in names
+            if k in ("loss_sum", "correct", "count")
+            or k.endswith("_sum") or k.endswith("_count")
+        }
         totals: Dict[str, float] = {}
         n = 0.0
         for j, valid in enumerate(valids):
             for i, k in enumerate(names):
-                # loss_sum/correct/count are already per-row sums; weight any
-                # other (per-batch mean) aux key by the batch's valid rows so
-                # the padded tail batch doesn't bias the average (ADVICE r1).
-                w = 1.0 if k in ("loss_sum", "correct", "count") else valid
+                # sum-style keys (loss_sum/correct/count and any *_sum /
+                # *_count aux, e.g. the GPT-2 token-weighted lm_loss_sum/
+                # token_count pair) are already masked per-element sums;
+                # weight any other (per-batch mean) aux key by the batch's
+                # valid rows so the padded tail batch doesn't bias the
+                # average (ADVICE r1, VERDICT r2 item 6).
+                w = 1.0 if k in sum_keys else valid
                 totals[k] = totals.get(k, 0.0) + w * float(mat[j, i])
             n += valid
         result = {"loss": totals.get("loss_sum", 0.0) / max(n, 1.0)}
         if "count" in totals and totals["count"] > 0:
             result["accuracy"] = totals.get("correct", 0.0) / totals["count"]
         for k, v in totals.items():
-            if k not in ("loss_sum", "correct", "count"):
-                result[k] = v / max(n, 1.0)
+            if k in ("loss_sum", "correct", "count"):
+                continue
+            # raw totals for sum-style aux; row-weighted mean for the rest
+            result[k] = v if k in sum_keys else v / max(n, 1.0)
         return result
 
     # -- weights ----------------------------------------------------------

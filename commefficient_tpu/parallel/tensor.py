@@ -47,7 +47,10 @@ from commefficient_tpu.models.gpt2 import (
     dense_causal_attention,
     manual_layer_norm as _layer_norm,
 )
-from commefficient_tpu.models.losses import IGNORE_INDEX
+from commefficient_tpu.models.losses import (
+    IGNORE_INDEX,
+    softmax_cross_entropy_sum,
+)
 from commefficient_tpu.parallel.mesh import MODEL, SEQ, WORKERS
 from commefficient_tpu.parallel.ring_attention import ring_attention
 
@@ -267,17 +270,123 @@ def tp_gpt2_apply(mesh, model, tp_params, input_ids, token_type_ids=None,
 
 
 # --------------------------------------------------------------------------
+# TP/SP loss over REPLICATED flat params — the federated-round integration
+# --------------------------------------------------------------------------
+
+
+def build_tp_flat_loss(cfg: GPT2Config, mesh, lm_coef: float = 1.0,
+                       mc_coef: float = 1.0):
+    """A ``loss_fn(params, batch, rng)`` whose COMPUTE is sharded over the
+    mesh's ``model`` (attention heads / MLP hidden) and ``seq`` (tokens,
+    ring attention) axes while the params stay the round engine's replicated
+    flat vector — the VERDICT r2 item-3 integration: per-client losses run
+    under the round's workers x model x seq ``shard_map`` and the gradient
+    flows back to the full flat vector (shard_map's replicated-input AD
+    auto-psums the per-shard contributions over ``model``/``seq``), so every
+    compression mode (sketch/topk/fedavg server algebra) is UNCHANGED.
+
+    Same (loss, aux) contract as ``models.losses.gpt2_double_heads_loss`` —
+    drop-in for ``FederatedSession(cfg, params, loss_fn=...)`` when the
+    session's mesh has model/seq axes. Only valid INSIDE that mesh's
+    shard_map (it uses axis_index/psum over MODEL/SEQ), so pass the dense
+    loss as ``eval_loss_fn`` (eval runs jit-replicated, params being
+    replicated anyway).
+
+    Memory note (honest): this shards ACTIVATIONS and matmul compute —
+    per-device activation memory is O(T/seq x heads/model) — but each chip
+    still holds the full replicated param/optimizer state; FSDP-style param
+    sharding of the flat vector is a further step, not implied here.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp_size, seq_size = sizes.get(MODEL, 1), sizes.get(SEQ, 1)
+    E, H = cfg.n_embd, cfg.n_head
+    if H % tp_size:
+        raise ValueError(f"n_head={H} must divide by model axis {tp_size}")
+    H_loc, F_loc = H // tp_size, 4 * E // tp_size
+
+    def _local_blocks(tp_blocks):
+        """Slice each device's head/hidden block out of the replicated
+        transformed tree (same shapes _forward_local expects of a sharded
+        tree; with tp_size == 1 the slices are the whole tensors)."""
+        m = jax.lax.axis_index(MODEL) if tp_size > 1 else 0
+        dyn = jax.lax.dynamic_slice_in_dim
+        out = []
+        for b in tp_blocks:
+            out.append(
+                {
+                    "ln_1": b["ln_1"],
+                    "ln_2": b["ln_2"],
+                    "attn_qkv_k": dyn(b["attn_qkv_k"], m * H_loc, H_loc, 2),
+                    "attn_qkv_b": dyn(b["attn_qkv_b"], m * H_loc, H_loc, 1),
+                    "attn_out_k": dyn(b["attn_out_k"], m * H_loc, H_loc, 0),
+                    "attn_out_b": b["attn_out_b"],
+                    "fc_k": dyn(b["fc_k"], m * F_loc, F_loc, 1),
+                    "fc_b": dyn(b["fc_b"], m * F_loc, F_loc, 0),
+                    "proj_k": dyn(b["proj_k"], m * F_loc, F_loc, 0),
+                    "proj_b": b["proj_b"],
+                }
+            )
+        return out
+
+    def loss_fn(params, batch, rng=None):
+        del rng
+        tp = tp_transform_params(params, cfg)
+        tp = {**tp, "blocks": _local_blocks(tp["blocks"])}
+        shape = batch["input_ids"].shape  # [B, N, T]
+        T = shape[-1]
+        if T % seq_size:
+            raise ValueError(f"T={T} must divide by seq axis {seq_size}")
+        t_loc = T // seq_size
+        s = jax.lax.axis_index(SEQ) if seq_size > 1 else 0
+        flat = lambda u: u.reshape(-1, T)
+        sl = lambda u: jax.lax.dynamic_slice_in_dim(u, s * t_loc, t_loc, -1)
+        ids = sl(flat(batch["input_ids"]))
+        tt_full = batch.get("token_type_ids")
+        tt = None if tt_full is None else sl(flat(tt_full))
+        mc = batch["mc_token_ids"].reshape(-1)
+        _, lm_local, mc_logits = _forward_local(tp, ids, tt, mc, cfg, seq_size)
+        # next-token shift done GLOBALLY on the replicated labels, then
+        # sliced — each shard scores its own token block against the
+        # globally shifted targets (the final global position has no next
+        # token -> IGNORE_INDEX)
+        labels = flat(batch["lm_labels"])
+        labels = jnp.concatenate(
+            [labels[:, 1:],
+             jnp.full((labels.shape[0], 1), IGNORE_INDEX, labels.dtype)], -1
+        )
+        lm_sum, lm_cnt = _ce_sums(lm_local, sl(labels))
+        lm_sum = jax.lax.psum(lm_sum, SEQ)
+        lm_cnt = jax.lax.psum(lm_cnt, SEQ)
+        lm_loss = lm_sum / jnp.maximum(lm_cnt, 1.0)
+        mc_logits = mc_logits.reshape(shape[:-1])  # [B, N]
+        mc_labels = batch["mc_labels"]
+        mc_loss_sum, mc_cnt = _ce_sums(mc_logits, mc_labels)
+        mc_loss = mc_loss_sum / jnp.maximum(mc_cnt, 1.0)
+        mc_mask = mc_labels != IGNORE_INDEX
+        correct = jnp.sum(
+            (jnp.argmax(mc_logits, -1) == mc_labels) & mc_mask
+        ).astype(jnp.float32)
+        loss = lm_coef * lm_loss + mc_coef * mc_loss
+        return loss, {
+            "lm_loss": lm_loss,
+            "mc_loss": mc_loss,
+            "correct": correct,
+            "count": mc_cnt,
+            "lm_loss_sum": lm_sum,
+            "token_count": lm_cnt,
+        }
+
+    return loss_fn
+
+
+# --------------------------------------------------------------------------
 # Full 3-axis training step: dp (workers) x tp (model) x sp (seq)
 # --------------------------------------------------------------------------
 
 
-def _ce_sums(logits, labels, ignore=IGNORE_INDEX):
-    """(sum of nll over valid labels, valid count) — psum-friendly."""
-    mask = (labels != ignore).astype(jnp.float32)
-    safe = jnp.where(labels == ignore, 0, labels)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-    nll = -jnp.take_along_axis(logp, safe[..., None], -1)[..., 0]
-    return jnp.sum(nll * mask), jnp.sum(mask)
+# masked-CE (sum, count) — shared with the dense loss path so the two can
+# never drift (was a local duplicate until the r3 review)
+_ce_sums = softmax_cross_entropy_sum
 
 
 def build_tp3d_train_step(mesh, model, lm_coef: float = 1.0,

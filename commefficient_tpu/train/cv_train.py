@@ -62,7 +62,8 @@ def build_model_and_data(cfg: Config):
     prep = None
     if cfg.dataset_name == "cifar10":
         train, test, real = load_fed_cifar10(
-            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid, seed=cfg.seed
+            cfg.dataset_dir, num_clients=cfg.num_clients, iid=cfg.iid,
+            seed=cfg.seed, synthetic_variant=cfg.synthetic_variant,
         )
         sample_shape = (1, 32, 32, 3)
         num_classes = cfg.resolved_num_classes

@@ -1,0 +1,69 @@
+"""Sketch rho=0.9 schedule sweep at full scale (north-star tuning).
+
+The r3 accuracy run showed sketch/true_topk with rho=0.9 destabilizing
+during the 24-epoch lr ramp at lr_scale=0.4 (while rho=0 matches
+uncompressed): with server momentum 0.9 the effective step is
+lr/(1-rho) = 10x lr, so lr 0.4 + rho 0.9 is effective-lr 4.0 — far above
+the uncompressed baseline's 0.4. This sweeps (lr_scale, pivot_epoch) for
+the flagship sketch config to find the stable schedule; the FetchSGD paper
+tunes lr per compression config the same way (§5).
+
+    python scripts/r3_sweep.py [--mode sketch] [--epochs 24]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="sketch")
+    ap.add_argument("--epochs", type=int, default=24)
+    ap.add_argument("--variant", default="concentrated")
+    ap.add_argument("--rho", type=float, default=0.9)
+    ap.add_argument("--grid", default="0.4:2,0.2:6,0.1:6,0.04:6",
+                    help="comma list of lr:pivot pairs")
+    args = ap.parse_args()
+
+    from commefficient_tpu.train.cv_train import (
+        build_model_and_data,
+        build_session_and_sampler,
+        train_loop,
+    )
+    from commefficient_tpu.utils.config import Config
+
+    k = 50_000
+    for pair in args.grid.split(","):
+        lr_s, piv_s = pair.split(":")
+        lr, piv = float(lr_s), int(piv_s)
+        cfg = Config(
+            dataset_name="cifar10", dataset_dir="./data", model="resnet9",
+            synthetic_variant=args.variant, num_epochs=args.epochs,
+            lr_scale=lr, pivot_epoch=piv, num_clients=16, num_workers=8,
+            num_devices=1, local_batch_size=64, weight_decay=5e-4, seed=42,
+            topk_method="threshold", mode=args.mode,
+            error_type="virtual" if args.mode in ("sketch", "true_topk") else "none",
+            virtual_momentum=args.rho if args.mode in ("sketch", "true_topk") else 0.0,
+            k=k, num_rows=5, num_cols=500_000, fuse_clients=True,
+        )
+        train, test, real, model, params, loss_fn, augment = (
+            build_model_and_data(cfg)
+        )
+        session, sampler = build_session_and_sampler(
+            cfg, train, params, loss_fn, augment
+        )
+        t0 = time.time()
+        val = train_loop(cfg, session, sampler, test)
+        print(f"== {args.mode} rho={args.rho} lr={lr} pivot={piv}: "
+              f"acc={val.get('accuracy', float('nan')):.4f} "
+              f"loss={val['loss']:.4f} ({time.time() - t0:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

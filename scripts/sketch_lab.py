@@ -44,6 +44,10 @@ def main():
     ap.add_argument("--band", type=int, default=16)
     ap.add_argument("--c_div", type=int, default=13, help="c = D / c_div")
     ap.add_argument("--k_div", type=int, default=130, help="k = D / k_div")
+    ap.add_argument("--variant", default="flat",
+                    help="synthetic stand-in: flat|concentrated")
+    ap.add_argument("--mode", default="sketch",
+                    help="sketch|uncompressed|true_topk|local_topk")
     args = ap.parse_args()
 
     import numpy as np
@@ -53,7 +57,7 @@ def main():
 
     from commefficient_tpu.data import FedSampler, augment_batch
     from commefficient_tpu.data.cifar import (
-        CIFAR10_MEAN, CIFAR10_STD, _synthetic_cifar, device_normalizer,
+        CIFAR10_MEAN, CIFAR10_STD, _synthetic_by_variant, device_normalizer,
     )
     from commefficient_tpu.data.fed_dataset import FedDataset
     from commefficient_tpu.models import ResNet9, classification_loss
@@ -70,13 +74,19 @@ def main():
     C, K = D // args.c_div, D // args.k_div
     print(f"D={D} c={C} k={K} lr={args.lr_scale} rho={args.virtual_momentum}")
 
-    tr_raw, te_raw = _synthetic_cifar(10)
+    tr_raw, te_raw = _synthetic_by_variant(10, args.variant)
     train = FedDataset(dict(tr_raw), 16, seed=42)
     test = FedDataset(dict(te_raw), 1, seed=42)
 
     cfg = Config(
-        mode="sketch", error_type="virtual",
-        virtual_momentum=args.virtual_momentum,
+        mode=args.mode,
+        error_type=(
+            "virtual" if args.mode in ("sketch", "true_topk")
+            else ("local" if args.mode == "local_topk" else "none")
+        ),
+        virtual_momentum=(
+            args.virtual_momentum if args.mode in ("sketch", "true_topk") else 0.0
+        ),
         k=K, num_rows=args.num_rows, num_cols=C, topk_method="threshold",
         sketch_band=args.band,
         fuse_clients=True, num_clients=16, num_workers=8, num_devices=1,
@@ -85,9 +95,10 @@ def main():
         pivot_epoch=args.pivot_epoch,
     )
     session = FederatedSession(cfg, params, loss_fn)
-    print(f"spec: band={session.spec.band} V={session.spec.V_row(0)} "
-          f"s={session.spec.s} scramble_block={session.spec.scramble_block} "
-          f"c_actual={session.spec.c_actual}")
+    if session.spec is not None:
+        print(f"spec: band={session.spec.band} V={session.spec.V_row(0)} "
+              f"s={session.spec.s} scramble_block={session.spec.scramble_block} "
+              f"c_actual={session.spec.c_actual}")
     sampler = FedSampler(train, num_workers=8, local_batch_size=64, seed=42,
                          augment=augment_batch)
     session.maybe_attach_data(train, sampler, augment_batch)

@@ -39,6 +39,23 @@ def test_validation():
         Config(num_workers=3, num_devices=2)
     with pytest.raises(ValueError):
         Config(num_clients=2, num_workers=8)
+    with pytest.raises(ValueError):
+        Config(synthetic_variant="bogus")
+
+
+def test_sketch_dampening_gated():
+    # known-divergent combination requires explicit opt-in (VERDICT r2 item 9)
+    with pytest.raises(ValueError, match="momentum_dampening"):
+        Config(mode="sketch", momentum_dampening=True)
+    # explicit opt-in for parity experiments still works
+    cfg = Config(mode="sketch", momentum_dampening=True,
+                 allow_unstable_sketch_dampening=True)
+    assert cfg.momentum_dampening is True
+    # AUTO (None) and False are unaffected
+    Config(mode="sketch", momentum_dampening=None)
+    Config(mode="sketch", momentum_dampening=False)
+    # dense-mode dampening unaffected
+    Config(mode="true_topk", momentum_dampening=True)
 
 
 def test_piecewise_linear_shape():

@@ -130,6 +130,16 @@ def test_hash_quality(spec):
         # m balls into V bins: max load within a small factor of the mean
         mean_load = spec.chunk_m / v_r
         assert counts.max() <= 4 * max(1.0, mean_load) + 3
+        # min-load / balance bound adapted to the banded geometry (ADVICE
+        # r2: the V-window move dropped the old 'no starved buckets'
+        # assertion). At this m/V the Poisson-expected empty fraction is
+        # e^-mean_load — a degenerate _offset_slots (e.g. collapsing to a
+        # sub-window) at least doubles it. 6-sigma binomial slack.
+        empty_frac = np.mean(counts == 0)
+        expect_empty = np.exp(-mean_load)
+        sigma = np.sqrt(max(expect_empty * (1 - expect_empty), 1e-12) / v_r)
+        assert empty_frac <= expect_empty + 6 * sigma + 1e-3, (
+            row, empty_frac, expect_empty)
         signs = np.asarray(spec._row_signs(row))
         assert abs(signs.mean()) < 0.05
         all_slots.append(slots)

@@ -186,7 +186,9 @@ def test_local_topk_with_virtual_momentum_trains():
 
 def test_sketch_momentum_dampening_zeroes_hh_coords():
     cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
-                 momentum_dampening=True, k=40, num_rows=5, num_cols=1024, **BASE)
+                 momentum_dampening=True, k=40, num_rows=5, num_cols=1024,
+                 # parity-experiment path, gated since r3 (VERDICT item 9)
+                 allow_unstable_sketch_dampening=True, **BASE)
     ds, params, loss_fn = _setup(cfg.num_clients)
     from commefficient_tpu.ops import estimate_all
     sess = FederatedSession(cfg, params, loss_fn)

@@ -73,6 +73,66 @@ def test_tp_rejects_indivisible_sequence():
         tp_gpt2_apply(mesh, model, tp, ids)
 
 
+def test_federated_tp_sp_round_matches_dp_oracle():
+    """VERDICT r2 item 3 'done' criterion: a workers=2 x model=2 x seq=2
+    federated SKETCH round trajectory matches the DP-only oracle — the TP/SP
+    axes shard each client's loss compute without changing the compression
+    or server algebra."""
+    from commefficient_tpu.data import FedSampler, load_fed_personachat
+    from commefficient_tpu.data.fed_dataset import FedDataset
+    from commefficient_tpu.models import GPT2DoubleHeads, gpt2_double_heads_loss
+    from commefficient_tpu.parallel import FederatedSession, mask_gpt2
+    from commefficient_tpu.parallel.tensor import build_tp_flat_loss
+    from commefficient_tpu.utils.config import Config
+
+    cfg_kw = dict(
+        mode="sketch", error_type="virtual", virtual_momentum=0.9, k=200,
+        num_rows=3, num_cols=10_000, num_epochs=1, num_clients=4,
+        num_workers=2, num_devices=2, local_batch_size=2, max_seq_len=T,
+        weight_decay=0.0, lr_scale=0.05, pivot_epoch=1, device_data=False,
+    )
+    train, test, real, vocab = load_fed_personachat(
+        "./nonexistent", num_clients=4, num_candidates=2, max_history=2,
+        max_seq_len=T, base_vocab=CFG.vocab_size - 5, seed=0,
+    )
+    gcfg = GPT2Config(
+        vocab_size=vocab, n_positions=T, n_embd=CFG.n_embd,
+        n_layer=CFG.n_layer, n_head=CFG.n_head, dtype=jnp.float32,
+    )
+    model = GPT2DoubleHeads(gcfg)
+    sample = next(iter(FedDataset(dict(train.data), 1, seed=0).eval_batches(1)))
+    params = model.init(
+        jax.random.key(0),
+        jnp.asarray(sample["input_ids"][:1]),
+        token_type_ids=jnp.asarray(sample["token_type_ids"][:1]),
+        mc_token_ids=jnp.asarray(sample["mc_token_ids"][:1]),
+    )
+    dense_loss = gpt2_double_heads_loss(model.apply)
+
+    def run(cfg):
+        if cfg.model_axis > 1 or cfg.seq_axis > 1:
+            mesh = make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis)
+            sess = FederatedSession(
+                cfg, params, build_tp_flat_loss(gcfg, mesh), mesh=mesh,
+                eval_loss_fn=dense_loss, mask_batch=mask_gpt2,
+            )
+        else:
+            sess = FederatedSession(cfg, params, dense_loss,
+                                    mask_batch=mask_gpt2)
+        sampler = FedSampler(train, num_workers=2, local_batch_size=2, seed=3)
+        losses = []
+        for r in range(4):
+            ids, batch = sampler.sample_round(r)
+            m = sess.train_round(ids, batch, 0.05)
+            losses.append(float(np.asarray(m["loss"])))
+        return losses, np.asarray(sess.state.params_vec)
+
+    oracle_losses, oracle_params = run(Config(**cfg_kw))
+    tp_losses, tp_params = run(Config(**cfg_kw, model_axis=2, seq_axis=2))
+    np.testing.assert_allclose(tp_losses, oracle_losses, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(tp_params, oracle_params, rtol=2e-3, atol=2e-4)
+
+
 def test_tp3d_train_step_matches_single_device_sgd():
     """One dp x tp x sp SGD step == one dense single-device SGD step."""
     mesh = make_mesh(2, 2, 2)
