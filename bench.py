@@ -24,6 +24,7 @@ measured ~50x slower through the axon tunnel runtime
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -36,25 +37,21 @@ import numpy as np
 BASELINE_SAMPLES_PER_SEC = 20_000.0
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
-
-    from commefficient_tpu.models import ResNet9, classification_loss
-    from commefficient_tpu.parallel import FederatedSession, make_mesh
+def _headline_cfg():
     from commefficient_tpu.utils.config import Config
 
     # 8 virtual workers x 256-sample local batches (FetchSGD's CIFAR configs
     # run local batches up to 500/client, paper §5) = 2048 samples/round.
     workers, batch = 8, 256
-    cfg = Config(
+    return Config(
         mode="sketch",
         error_type="virtual",
         virtual_momentum=0.9,
         k=50_000,
         num_rows=5,
         num_cols=500_000,
-        num_blocks=4,
+        num_blocks=1,  # r3: num_blocks>1 now really chunks (slower); 1 keeps
+        # the computation identical to the r1/r2 headline runs
         topk_method="threshold",
         fuse_clients=True,
         num_clients=2 * workers,
@@ -63,6 +60,17 @@ def main():
         local_batch_size=batch,
         weight_decay=5e-4,
     )
+
+
+def _measure(cfg, n_rounds: int = 20) -> float:
+    """samples/s of the full federated round under ``cfg`` (one chip)."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+
+    workers, batch = cfg.num_workers, cfg.local_batch_size
     model = ResNet9(num_classes=10)
     params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
     loss_fn = classification_loss(model.apply)
@@ -75,9 +83,15 @@ def main():
     ids = jnp.asarray(
         rng.choice(cfg.num_clients, size=workers, replace=False).astype(np.int32)
     )
+    shape = (workers, batch, 32, 32, 3)
+    if cfg.mode == "fedavg":  # microbatch convention [W, L, B/L, ...]
+        L = cfg.num_local_iters
+        shape = (workers, L, batch // L, 32, 32, 3)
     data = {
-        "x": jnp.asarray(rng.normal(size=(workers, batch, 32, 32, 3)).astype(np.float32)),
-        "y": jnp.asarray(rng.integers(0, 10, size=(workers, batch)).astype(np.int32)),
+        "x": jnp.asarray(rng.normal(size=shape).astype(np.float32)),
+        "y": jnp.asarray(
+            rng.integers(0, 10, size=shape[:-3]).astype(np.int32)
+        ),
     }
     state, round_fn = session.state, session.round_fn
     lr = jnp.float32(0.1)
@@ -90,21 +104,65 @@ def main():
         state, m = round_fn(state, ids, data, lr)
         assert np.isfinite(float(m["loss"]))
 
-    n_rounds = 20
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, m = round_fn(state, ids, data, lr)
     assert np.isfinite(float(m["loss"]))  # fence
     dt = time.perf_counter() - t0
+    return n_rounds * workers * batch / dt
 
-    samples_per_sec = n_rounds * workers * batch / dt
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--matrix", action="store_true",
+        help="also time the non-headline federated paths (sketch-vmap with "
+        "clipping, local_topk + local error, fedavg) and write "
+        "BENCH_MATRIX.json; the headline line stays the LAST stdout line",
+    )
+    args = ap.parse_args()
+
+    rows = {}
+    if args.matrix:
+        # The paths the reference actually calls federated (VERDICT r2 item
+        # 5): clip/DP/local-state configs are vmap-per-client (the fused
+        # flat-batch identity needs nothing per-client), so they pay W
+        # separate gradient passes at B instead of one at W*B.
+        base = _headline_cfg()
+        matrix = {
+            "sketch_vmap_clip": base.replace(
+                fuse_clients=False, max_grad_norm=1.0
+            ),
+            "local_topk_local_err": base.replace(
+                mode="local_topk", error_type="local", virtual_momentum=0.0,
+                fuse_clients=False,
+            ),
+            "fedavg_4local": base.replace(
+                mode="fedavg", error_type="none", virtual_momentum=0.0,
+                num_local_iters=4,
+            ),
+            "uncompressed_fused": base.replace(
+                mode="uncompressed", error_type="none", virtual_momentum=0.0,
+            ),
+        }
+        for name, cfg in matrix.items():
+            sps = _measure(cfg)
+            rows[name] = round(sps, 2)
+            print(json.dumps({"metric": name, "value": rows[name],
+                              "unit": "samples/s"}))
+
+    headline = _measure(_headline_cfg())
+    if args.matrix:
+        rows["sketch_fused_headline"] = round(headline, 2)
+        with open("BENCH_MATRIX.json", "w") as f:
+            json.dump(rows, f, indent=2)
     print(
         json.dumps(
             {
                 "metric": "fed_resnet9_sketch_train_samples_per_sec_per_chip",
-                "value": round(samples_per_sec, 2),
+                "value": round(headline, 2),
                 "unit": "samples/s",
-                "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 4),
+                "vs_baseline": round(headline / BASELINE_SAMPLES_PER_SEC, 4),
             }
         )
     )

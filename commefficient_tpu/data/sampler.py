@@ -111,11 +111,9 @@ class FedSampler:
         for k, v in data.items():
             if k == "x" and self._planner is not None:
                 p = self._planner.plan(rng, W * B, v.shape[1], v.shape[2])
-                out = native.gather_augment(
-                    v, flat, p,
-                    pad=self._planner.pad, cut_half=self._planner.cut_half,
-                    fill=self._planner._fill(v.dtype, v.shape[-1]),
-                )
+                # fused native gather+augment (planner-specific kernel);
+                # None when the C++ lib is absent
+                out = self._planner.gather_apply(v, flat, p)
                 if out is None:  # no native lib: numpy gather + apply
                     out = self._planner.apply(np.ascontiguousarray(v[flat]), p)
             else:

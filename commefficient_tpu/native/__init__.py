@@ -83,6 +83,17 @@ def _bind(path: str):
         ctypes.c_char_p, _I64P, ctypes.c_int64, ctypes.c_int64, ctypes.c_char_p,
     ]
     lib.fedloader_gather_rows.restype = None
+    for name, ptr in (
+        ("fedloader_gather_rrc", _F32P),
+        ("fedloader_gather_rrc_u8", _U8P),
+    ):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ptr, ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            _I64P, ctypes.c_int64,
+            _I32P, _I32P, _I32P, _I32P, _U8P, ptr,
+        ]
+        fn.restype = None
     return lib
 
 
@@ -171,6 +182,61 @@ def gather_augment(
     fn(
         data.ctypes.data_as(ptr), data.shape[0], h, w, c,
         idx.ctypes.data_as(_I64P), n, *args,
+        out.ctypes.data_as(ptr),
+    )
+    return out
+
+
+def gather_rrc(data: np.ndarray, idx: np.ndarray, plan) -> Optional[np.ndarray]:
+    """out[i] = random_resized_crop(data[idx[i]], plan[i]) via the native
+    kernel — the ImageNet train transform (data.imagenet.ImageNetAugment).
+
+    ``plan`` is an RRCPlan (ys/xs/hs/ws int32 crop boxes + flips). Returns
+    None when the library is unavailable (callers fall back to numpy).
+    Interpolated pixels can differ from the numpy path by 1 uint8 LSB
+    (FMA contraction under -O3) — pinned by tests/test_native_loader.py.
+    """
+    lib = load()
+    if lib is None or data.ndim != 4:
+        return None
+    if data.dtype == np.uint8:
+        fn, ptr = lib.fedloader_gather_rrc_u8, _U8P
+    elif data.dtype == np.float32:
+        fn, ptr = lib.fedloader_gather_rrc, _F32P
+    else:
+        return None
+    data = np.ascontiguousarray(data)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    _check_idx(idx, data.shape[0])
+    n = int(idx.shape[0])
+    _, h, w, c = data.shape
+    ys = np.ascontiguousarray(plan.ys, np.int32)
+    xs = np.ascontiguousarray(plan.xs, np.int32)
+    hs = np.ascontiguousarray(plan.hs, np.int32)
+    ws = np.ascontiguousarray(plan.ws, np.int32)
+    # the kernel reads plan[i] for every i < n unchecked: a plan built for
+    # a smaller batch would be a silent out-of-bounds heap read
+    if not (len(ys) == len(xs) == len(hs) == len(ws) == len(plan.flips) == n):
+        raise ValueError(
+            f"plan arrays must match idx length {n}, got "
+            f"{[len(a) for a in (ys, xs, hs, ws, plan.flips)]}"
+        )
+    # the kernel reads rows ys+hs-1 / cols xs+ws-1 unchecked: validate the
+    # crop boxes like _check_idx validates sample indices
+    if n and (
+        int(hs.min()) < 1 or int(ws.min()) < 1
+        or int(ys.min()) < 0 or int(xs.min()) < 0
+        or int((ys + hs).max()) > h or int((xs + ws).max()) > w
+    ):
+        raise IndexError("RRC crop box out of image bounds")
+    flips = np.ascontiguousarray(plan.flips, np.uint8)
+    out = np.empty((n, h, w, c), data.dtype)
+    fn(
+        data.ctypes.data_as(ptr), data.shape[0], h, w, c,
+        idx.ctypes.data_as(_I64P), n,
+        ys.ctypes.data_as(_I32P), xs.ctypes.data_as(_I32P),
+        hs.ctypes.data_as(_I32P), ws.ctypes.data_as(_I32P),
+        flips.ctypes.data_as(_U8P),
         out.ctypes.data_as(ptr),
     )
     return out

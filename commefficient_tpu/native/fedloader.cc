@@ -14,6 +14,7 @@
 // path in commefficient_tpu/data/cifar.py (pure copies and zeroing — no
 // arithmetic), pinned by tests/test_native_loader.py.
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 
@@ -69,6 +70,85 @@ void gather_augment_impl(const T* data, int H, int W, int C,
   }
 }
 
+// Bilinear sampling coordinate for resizing a crop_len axis to out_len
+// (torch/PIL align_corners=False): src = (dst + 0.5) * crop/out - 0.5.
+inline void bilin(int t, int out_len, int crop_len, int* lo, int* hi,
+                  float* w) {
+  float g = ((float)t + 0.5f) * ((float)crop_len / (float)out_len) - 0.5f;
+  if (g < 0.0f) g = 0.0f;
+  const float mx = (float)crop_len - 1.0f;
+  if (g > mx) g = mx;
+  *lo = (int)g;  // g >= 0: trunc == floor
+  *hi = *lo + 1 < crop_len ? *lo + 1 : crop_len - 1;
+  *w = g - (float)*lo;
+}
+
+// Fused gather + random-resized-crop (bilinear) + hflip — the ImageNet
+// train transform (see data/imagenet.py ImageNetAugment). Lerp form
+// a + (b - a) * t in float32, matching the numpy/jnp paths (FMA
+// contraction under -O3 can differ in the last bit; the equivalence tests
+// allow 1 uint8 LSB).
+template <typename T>
+void gather_rrc_impl(const T* data, int H, int W, int C, const int64_t* idx,
+                     int64_t n, const int32_t* ys, const int32_t* xs,
+                     const int32_t* hs, const int32_t* ws,
+                     const uint8_t* flips, T* out) {
+  const int64_t img = (int64_t)H * W * C;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const T* src = data + idx[i] * img;
+    T* dst = out + i * img;
+    const int ch = hs[i], cw = ws[i];
+    const bool fl = flips[i] != 0;
+    // x-axis coordinates depend only on (col, W, cw): hoist the W
+    // bilin calls out of the row loop (LUTs on the stack; W <= 4096)
+    int x0s[4096], x1s[4096];
+    float wxs[4096];
+    for (int col = 0; col < W && col < 4096; ++col)
+      bilin(col, W, cw, &x0s[col], &x1s[col], &wxs[col]);
+    for (int r = 0; r < H; ++r) {
+      int y0, y1;
+      float wy;
+      bilin(r, H, ch, &y0, &y1, &wy);
+      const T* row0 = src + (int64_t)(ys[i] + y0) * W * C;
+      const T* row1 = src + (int64_t)(ys[i] + y1) * W * C;
+      T* drow = dst + (int64_t)r * W * C;
+      for (int col = 0; col < W; ++col) {
+        // flip is applied AFTER the resize: output col reads resized
+        // column W-1-col for flipped images
+        const int cc = fl ? (W - 1 - col) : col;
+        int x0, x1;
+        float wx;
+        if (cc < 4096) {
+          x0 = x0s[cc]; x1 = x1s[cc]; wx = wxs[cc];
+        } else {
+          bilin(cc, W, cw, &x0, &x1, &wx);
+        }
+        const T* p00 = row0 + (int64_t)(xs[i] + x0) * C;
+        const T* p01 = row0 + (int64_t)(xs[i] + x1) * C;
+        const T* p10 = row1 + (int64_t)(xs[i] + x0) * C;
+        const T* p11 = row1 + (int64_t)(xs[i] + x1) * C;
+        T* dpix = drow + (int64_t)col * C;
+        for (int c = 0; c < C; ++c) {
+          const float a = (float)p00[c], b = (float)p01[c];
+          const float d0 = (float)p10[c], d1 = (float)p11[c];
+          const float top = a + (b - a) * wx;
+          const float bot = d0 + (d1 - d0) * wx;
+          const float v = top + (bot - top) * wy;
+          if (sizeof(T) == 1) {
+            float rv = nearbyintf(v);
+            if (rv < 0.0f) rv = 0.0f;
+            if (rv > 255.0f) rv = 255.0f;
+            dpix[c] = (T)rv;
+          } else {
+            dpix[c] = (T)v;
+          }
+        }
+      }
+    }
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -100,6 +180,26 @@ void fedloader_gather_augment_u8(const uint8_t* data, int64_t N, int H,
   (void)N;
   gather_augment_impl<uint8_t>(data, H, W, C, idx, n, ys, xs, flips, cys,
                                cxs, pad, cut_half, fill, out);
+}
+
+// data: [N, H, W, C]; idx: [n]; ys/xs/hs/ws: [n] integer crop boxes;
+// flips: [n] 0/1. out: [n, H, W, C] (each crop resized back to H x W).
+void fedloader_gather_rrc(const float* data, int64_t N, int H, int W, int C,
+                          const int64_t* idx, int64_t n, const int32_t* ys,
+                          const int32_t* xs, const int32_t* hs,
+                          const int32_t* ws, const uint8_t* flips,
+                          float* out) {
+  (void)N;
+  gather_rrc_impl<float>(data, H, W, C, idx, n, ys, xs, hs, ws, flips, out);
+}
+
+void fedloader_gather_rrc_u8(const uint8_t* data, int64_t N, int H, int W,
+                             int C, const int64_t* idx, int64_t n,
+                             const int32_t* ys, const int32_t* xs,
+                             const int32_t* hs, const int32_t* ws,
+                             const uint8_t* flips, uint8_t* out) {
+  (void)N;
+  gather_rrc_impl<uint8_t>(data, H, W, C, idx, n, ys, xs, hs, ws, flips, out);
 }
 
 // Plain indexed gather: out[i, :] = data[idx[i], :], row_elems elements of

@@ -85,6 +85,8 @@ class FederatedSession:
                 seed=cfg.seed,
                 dtype=jnp.bfloat16 if cfg.sketch_dtype == "bfloat16" else jnp.float32,
                 band=cfg.sketch_band,
+                hash_family=cfg.hash_family,
+                m=cfg.sketch_m,
             )
         self.state = init_state(cfg, vec, self.spec)
         self.host_vel = self.host_err = None
@@ -143,16 +145,18 @@ class FederatedSession:
         TPU tunnel; a float32 CIFAR batch alone cost ~310 ms/round) —
         carries practically nothing.
 
-        ``augment`` is a plan-based augmenter (data.cifar.CifarAugment) or
-        None. The gathered+augmented batch is bit-identical to the host
-        paths (same plan semantics), so training is unchanged.
+        ``augment`` is a plan-based augmenter (data.cifar.CifarAugment,
+        data.imagenet.ImageNetAugment) or None; its ``device_apply(x,
+        *plan)`` realizes the same plan as the host paths inside the trace,
+        so training is unchanged (bit-identical for the pure index/select
+        CIFAR ops; within 1 uint8 LSB for bilinear RRC — see the
+        augmenters).
         """
         if self.cfg.offload_client_state:
             raise NotImplementedError(
                 "device-resident data + host-offloaded client state is "
                 "contradictory; pick one"
             )
-        from commefficient_tpu.data.cifar import device_augment
         from commefficient_tpu.parallel.round import build_round_fn as _brf
 
         self._dev_data = {
@@ -163,13 +167,7 @@ class FederatedSession:
             self.cfg, self._loss_fn, self.unravel, self.mesh, self.spec,
             _jit=False,
         )
-        pad = getattr(augment, "pad", 4)
-        cut = getattr(augment, "cut_half", 4)
         has_aug = augment is not None
-        fill = None
-        if has_aug and "x" in data and hasattr(augment, "_fill"):
-            xh = np.asarray(data["x"])
-            fill = augment._fill(xh.dtype, xh.shape[-1])
         L = self.cfg.num_local_iters if self.cfg.mode == "fedavg" else 0
 
         def round_idx_fn(state, data, client_ids, idx, plan, lr):
@@ -179,7 +177,7 @@ class FederatedSession:
             for k, v in data.items():
                 g = v[flat]
                 if k == "x" and has_aug:
-                    g = device_augment(g, *plan, pad=pad, cut_half=cut, fill=fill)
+                    g = augment.device_apply(g, *plan)
                 batch[k] = g.reshape((W, B) + g.shape[1:])
             if L:  # fedavg microbatch convention ([W, L, B/L, ...]), any L
                 batch = {

@@ -94,7 +94,12 @@ def build_model_and_data(cfg: Config):
         )
         sample_shape = (1,) + train.data["x"].shape[1:]
         num_classes = cfg.resolved_num_classes
-        augment = None
+        # train-time random-resized-crop + flip (the reference's
+        # torchvision transform, data_utils/fed_imagenet.py ~L1-120) —
+        # plan-based so the native kernel and device-resident path apply it
+        from commefficient_tpu.data.imagenet import ImageNetAugment
+
+        augment = ImageNetAugment()
         prep = device_normalizer(IMAGENET_MEAN, IMAGENET_STD)
     else:
         raise ValueError(f"unknown dataset {cfg.dataset_name!r}")

@@ -33,7 +33,9 @@ class Config:
     topk_method: str = "exact"
     num_rows: int = 5  # sketch rows r
     num_cols: int = 500_000  # sketch columns c
-    num_blocks: int = 1  # memory chunking for full-d unsketch estimates
+    # >1 bounds full-d unsketch-estimate transients to r*D/num_blocks via
+    # the exact-gather path (slower; reference --num_blocks memory trade)
+    num_blocks: int = 1
     do_topk_down: bool = False  # top-k compress the downlink too
 
     # --- momentum / error feedback (reference: --virtual_momentum,
@@ -145,6 +147,14 @@ class Config:
     # sketch statistics (stabler FetchSGD feedback), smaller = cheaper
     # matmuls. band=16 measured stable at paper-scale d/c=13.
     sketch_band: int = 16
+    # Explicit CountSketch chunk size m (None = the measured adaptive rule,
+    # ops/countsketch.py chunk_m). Lab knob for the d/c~100 regime.
+    sketch_m: Optional[int] = None
+    # Hash family: "fmix32" (production default) or "poly4" — seed-derived
+    # 4-universal Mersenne polynomials, the reference csvec's guarantee
+    # class, for lab A/B runs against fmix32 (CV scale; see
+    # ops/countsketch.py CountSketch.hash_family).
+    hash_family: str = "fmix32"
 
     # --- mesh axes beyond the reference (TPU-native; VERDICT r2 item 3) ---
     # The federated round's mesh is (workers=num_devices, model=model_axis,
@@ -190,6 +200,10 @@ class Config:
                 "not mask sketched momentum: use momentum_dampening=None/"
                 "False, or set allow_unstable_sketch_dampening=True for "
                 "parity experiments."
+            )
+        if self.hash_family not in ("fmix32", "poly4"):
+            raise ValueError(
+                f"hash_family must be fmix32|poly4, got {self.hash_family!r}"
             )
         if self.synthetic_variant not in ("flat", "concentrated"):
             raise ValueError(

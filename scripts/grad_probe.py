@@ -102,7 +102,10 @@ def main():
         return mass, recall, wrecall
 
     def probe_now(tag, epoch):
-        # a big "round" batch: 512 samples, augmented like training
+        # a big "round" batch: 512 raw (UNaugmented) samples — crop/flip/
+        # cutout shifts early-conv gradient structure slightly, so these
+        # recall numbers are the clean-image statistic, not exactly the
+        # training-round statistic
         rng = np.random.default_rng(123 + epoch)
         idx = rng.choice(len(tr_raw["y"]), size=512, replace=False)
         batch = {"x": tr_raw["x"][idx], "y": tr_raw["y"][idx]}

@@ -125,13 +125,13 @@ def main():
           f"(bench does {workers * batch}/round)")
 
     # ground truth: the EXACT bench config (bench.py r2: fuse_clients,
-    # batch 256, num_blocks 4) so this number reconciles against bench.py
+    # batch 256, num_blocks 1) so this number reconciles against bench.py
     from commefficient_tpu.parallel import FederatedSession, make_mesh
     from commefficient_tpu.utils.config import Config
 
     bench_batch = batch  # == the bench r2 shape profiled above
     cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
-                 k=k, num_rows=5, num_cols=500_000, num_blocks=4,
+                 k=k, num_rows=5, num_cols=500_000, num_blocks=1,
                  topk_method="threshold", fuse_clients=True,
                  num_clients=2 * workers, num_workers=workers, num_devices=1,
                  local_batch_size=bench_batch, weight_decay=5e-4)

@@ -48,6 +48,12 @@ def main():
                     help="synthetic stand-in: flat|concentrated")
     ap.add_argument("--mode", default="sketch",
                     help="sketch|uncompressed|true_topk|local_topk")
+    ap.add_argument("--hash_family", default="fmix32",
+                    help="fmix32 (production) | poly4 (4-universal "
+                         "Mersenne-poly A/B backstop, VERDICT r2 item 7)")
+    ap.add_argument("--m", type=int, default=None,
+                    help="override the adaptive chunk size (d/c~100 regime "
+                         "experiments)")
     args = ap.parse_args()
 
     import numpy as np
@@ -88,7 +94,7 @@ def main():
             args.virtual_momentum if args.mode in ("sketch", "true_topk") else 0.0
         ),
         k=K, num_rows=args.num_rows, num_cols=C, topk_method="threshold",
-        sketch_band=args.band,
+        sketch_band=args.band, hash_family=args.hash_family, sketch_m=args.m,
         fuse_clients=True, num_clients=16, num_workers=8, num_devices=1,
         local_batch_size=64, weight_decay=5e-4, seed=42,
         num_epochs=args.num_epochs, lr_scale=args.lr_scale,
