@@ -194,7 +194,7 @@ def gather_rrc(data: np.ndarray, idx: np.ndarray, plan) -> Optional[np.ndarray]:
     ``plan`` is an RRCPlan (ys/xs/hs/ws int32 crop boxes + flips). Returns
     None when the library is unavailable (callers fall back to numpy).
     Interpolated pixels can differ from the numpy path by 1 uint8 LSB
-    (FMA contraction under -O3) — pinned by tests/test_native_loader.py.
+    (FMA contraction under -O3) — pinned by tests/test_imagenet_augment.py.
     """
     lib = load()
     if lib is None or data.ndim != 4:
@@ -226,7 +226,10 @@ def gather_rrc(data: np.ndarray, idx: np.ndarray, plan) -> Optional[np.ndarray]:
     if n and (
         int(hs.min()) < 1 or int(ws.min()) < 1
         or int(ys.min()) < 0 or int(xs.min()) < 0
-        or int((ys + hs).max()) > h or int((xs + ws).max()) > w
+        # int64 sums: int32 ys+hs could wrap negative for corrupt plans
+        # and sneak past the max() check
+        or int((ys.astype(np.int64) + hs).max()) > h
+        or int((xs.astype(np.int64) + ws).max()) > w
     ):
         raise IndexError("RRC crop box out of image bounds")
     flips = np.ascontiguousarray(plan.flips, np.uint8)

@@ -326,7 +326,13 @@ class CountSketch(NamedTuple):
         return self._L_row(row) // self.chunk_m
 
     def u_row(self, row: int) -> int:
-        """Band width (windows per chunk) for this row, capped by nc."""
+        """Band width (windows per chunk) for this row, capped by nc.
+
+        Band width does NOT rescue the d/c~100 regime: the r3 lab measured
+        band=16 and global windows (band >= nc, pool = half the row)
+        diverging IDENTICALLY at quarter scale (loss ~2e17 by epoch 12,
+        fmix32 and poly4 alike, lr 0.04 and 0.08 alike) — see the
+        hash_family note and CHANGELOG_r3 for the regime account."""
         return max(1, min(self.band or 1, self._nc_row(row)))
 
     def s_row(self, row: int) -> int:

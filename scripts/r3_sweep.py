@@ -29,6 +29,13 @@ def main():
     ap.add_argument("--rho", type=float, default=0.9)
     ap.add_argument("--grid", default="0.4:2,0.2:6,0.1:6,0.04:6",
                     help="comma list of lr:pivot pairs")
+    ap.add_argument("--num_rows", type=int, default=5)
+    ap.add_argument("--num_cols", type=int, default=500_000)
+    ap.add_argument("--k", type=int, default=50_000)
+    ap.add_argument("--apply_rho_to_all", action="store_true",
+                    help="use --rho as server momentum for ANY mode (e.g. "
+                         "an uncompressed momentum-SGD baseline), not just "
+                         "sketch/true_topk")
     args = ap.parse_args()
 
     from commefficient_tpu.train.cv_train import (
@@ -38,7 +45,7 @@ def main():
     )
     from commefficient_tpu.utils.config import Config
 
-    k = 50_000
+    k = args.k
     for pair in args.grid.split(","):
         lr_s, piv_s = pair.split(":")
         lr, piv = float(lr_s), int(piv_s)
@@ -49,8 +56,13 @@ def main():
             num_devices=1, local_batch_size=64, weight_decay=5e-4, seed=42,
             topk_method="threshold", mode=args.mode,
             error_type="virtual" if args.mode in ("sketch", "true_topk") else "none",
-            virtual_momentum=args.rho if args.mode in ("sketch", "true_topk") else 0.0,
-            k=k, num_rows=5, num_cols=500_000, fuse_clients=True,
+            virtual_momentum=(
+                args.rho
+                if args.apply_rho_to_all or args.mode in ("sketch", "true_topk")
+                else 0.0
+            ),
+            k=k, num_rows=args.num_rows, num_cols=args.num_cols,
+            fuse_clients=True,
         )
         train, test, real, model, params, loss_fn, augment = (
             build_model_and_data(cfg)

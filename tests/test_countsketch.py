@@ -377,7 +377,7 @@ def test_adversarial_equal_magnitude_ties(family):
 def test_adversarial_feedback_iteration_bounded(family):
     """The FetchSGD extract-and-subtract loop on a FIXED structured input
     (the v3/v4 divergence reproducer, miniaturized): error table mass must
-    stay bounded over 40 rounds for both hash families. This is the
+    stay bounded over the iterated rounds for both hash families (16 here — the documented r2 divergences showed up within ~6; the multi-epoch lab holds the long-horizon property). This is the
     multi-epoch-lab property reduced to a unit test."""
     sp = CountSketch(d=D, c=C, r=R, seed=7, m=64, hash_family=family)
     rng = np.random.default_rng(35)
@@ -387,7 +387,7 @@ def test_adversarial_feedback_iteration_bounded(family):
     k = 64
     e = jnp.zeros(sp.table_shape, jnp.float32)
     ref = float(jnp.abs(sketch_vec(sp, g)).max())
-    for _ in range(40):
+    for _ in range(16):
         e = e + sketch_vec(sp, g)
         upd = unsketch(sp, e, k)
         e = e - sketch_vec(sp, upd)

@@ -207,7 +207,7 @@ def test_cv_train_takes_device_data_path_e2e(tmp_path):
             num_clients=4,
             num_workers=2,
             num_devices=1,
-            local_batch_size=8,
+            local_batch_size=16,  # 1-core CPU budget: 15 rounds, not 30
             num_epochs=1,
             pivot_epoch=1,
             lr_scale=0.05,

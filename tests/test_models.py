@@ -32,6 +32,8 @@ def test_resnet9_shapes_and_param_count():
     assert 6_000_000 < n < 7_500_000, n
 
 
+@pytest.mark.slow  # training sanity is held (faster) by the e2e entry
+# tests; this isolates the bare model+grad path
 def test_resnet9_loss_decreases_one_sgd_step():
     model = ResNet9(num_classes=10, width=16)
     rng = jax.random.key(0)

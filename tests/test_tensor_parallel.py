@@ -42,7 +42,18 @@ def test_tp_transform_roundtrip():
     )
 
 
-@pytest.mark.parametrize("axes", [(1, 4, 1), (1, 2, 2), (1, 1, 4)])
+@pytest.mark.parametrize(
+    "axes",
+    [
+        # default tier keeps the MIXED case (exercises both tp and sp
+        # paths); the single-axis cases are the slow tier — same code
+        # paths, one axis trivial (1-core CPU suite budget, VERDICT r2
+        # item 8)
+        pytest.param((1, 4, 1), marks=pytest.mark.slow),
+        (1, 2, 2),
+        pytest.param((1, 1, 4), marks=pytest.mark.slow),
+    ],
+)
 def test_tp_forward_matches_dense(axes):
     mesh = make_mesh(*axes)
     model, params, ids, tt, mc = _setup()
@@ -133,6 +144,8 @@ def test_federated_tp_sp_round_matches_dp_oracle():
     np.testing.assert_allclose(tp_params, oracle_params, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # the federated composition below (dp oracle test) holds
+# the default-tier coverage for the 3-axis step
 def test_tp3d_train_step_matches_single_device_sgd():
     """One dp x tp x sp SGD step == one dense single-device SGD step."""
     mesh = make_mesh(2, 2, 2)
