@@ -296,11 +296,13 @@ class CountSketch(NamedTuple):
         = 1526): s=40 -> 2.8e13, s=80 -> 8.7e6, s=160 -> 6981, s=312 ->
         1812, s=624 -> 1680. s~256+ is classic-equivalent; the adaptive
         rule targets that. The larger m also keeps the per-chunk floor of
-        8 from inflating the realized table at large d/c (GPT-2 scale:
-        d=124M, c=1.25M -> m=32768, s~328 — inside the measured-stable
-        pool band; the cap bounds the [m, s] one-hot operand at ~40 MB,
-        and d/c~100 is outside the band's measurement regime, so validate
-        long GPT-2 sketch runs empirically)."""
+        8 from inflating the realized table at large d/c (the cap bounds
+        the [m, s] one-hot operand at ~40 MB). NB the d/c RATIO itself has
+        a measured stability envelope independent of this geometry: the
+        r3 lab measured d/c<=25 stable and d/c>=50 diverging for EVERY
+        layout tried (banded, global pools, classic scatter, poly4) —
+        FetchSGD-style virtual-error feedback runs out of SNR, so GPT-2
+        scale needs c >= D/25 (FederatedSession warns; CHANGELOG_r3)."""
         if self.m is not None:
             return min(self.m, _ceil_mult(self.d, 8))
         m = 512

@@ -88,6 +88,21 @@ class FederatedSession:
                 hash_family=cfg.hash_family,
                 m=cfg.sketch_m,
             )
+            if self.grad_size > 25 * cfg.num_cols:
+                import warnings
+
+                warnings.warn(
+                    f"sketch mode at d/c = {self.grad_size / cfg.num_cols:.0f} "
+                    "is OUTSIDE the measured-stable envelope: the r3 lab "
+                    "measured d/c<=25 stable and d/c>=50 diverging (exact "
+                    "classic sketch, global collision pools, and 4-universal "
+                    "hashing all diverge identically — it is an error-"
+                    "feedback SNR property of the regime, not a layout or "
+                    "hash artifact; CHANGELOG_r3.md). Raise num_cols to "
+                    f">= {-(-self.grad_size // 25):,} or validate this "
+                    "exact config with scripts/sketch_lab.py before a "
+                    "long run."
+                )
         self.state = init_state(cfg, vec, self.spec)
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
