@@ -92,7 +92,7 @@ class FederatedSession:
                 import warnings
 
                 warnings.warn(
-                    f"sketch mode at d/c = {self.grad_size / cfg.num_cols:.0f} "
+                    f"sketch mode at d/c = {self.grad_size / cfg.num_cols:.1f} "
                     "is OUTSIDE the measured-stable envelope: the r3 lab "
                     "measured d/c<=25 stable and d/c>=50 diverging (exact "
                     "classic sketch, global collision pools, and 4-universal "

@@ -9,12 +9,17 @@ multiple-choice accuracy, and ``save_pretrained`` HF-format checkpointing.
 Run-command parity examples:
 
   python -m commefficient_tpu.train.gpt2_train --mode sketch --k 50000 \
-      --num_rows 5 --num_cols 1250000 --virtual_momentum 0.9 \
+      --num_rows 5 --num_cols 5000000 --virtual_momentum 0.9 \
       --error_type virtual --num_workers 8 --num_devices 8   # BASELINE #4
   python -m commefficient_tpu.train.gpt2_train --model gpt2_tiny \
       --num_epochs 2 --num_workers 2 --num_devices 1         # CPU smoke
 
-At GPT-2 scale (D ~= 124M) use ``--offload_client_state true`` for
+Sketch sizing at GPT-2 scale: keep ``num_cols >= D/25`` (~5M for
+GPT-2-small, ~5x upload compression — the reference's own GPT-2 run
+compresses ~3.9x uplink). The r3 lab measured d/c >= 50 DIVERGING under
+virtual-error feedback for every sketch layout including a textbook
+scatter sketch (CHANGELOG_r3.md); FederatedSession warns if a config is
+outside the envelope. Use ``--offload_client_state true`` for
 local-error/local-momentum configs — per-client state stays in host RAM
 (SURVEY.md §7 hard-parts).
 """

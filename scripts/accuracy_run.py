@@ -56,6 +56,7 @@ def main():
         "uncompressed": (0.8, piv),
         "uncompressed_mom": (0.06, piv),
         "sketch_rho09": (0.04, 2),
+        "sketch_rho09_r7": (0.1, 2),
         "sketch_rho0": (0.4, piv),
         "true_topk": (0.04, 2),
         "local_topk": (0.4, piv),
@@ -74,6 +75,10 @@ def main():
         ("sketch (FetchSGD, rho=0.9)", mk(
             "sketch_rho09", mode="sketch", error_type="virtual",
             virtual_momentum=0.9, k=k, num_rows=5, num_cols=500_000,
+            fuse_clients=True)),
+        ("sketch (FetchSGD, rho=0.9, 7x357k)", mk(
+            "sketch_rho09_r7", mode="sketch", error_type="virtual",
+            virtual_momentum=0.9, k=k, num_rows=7, num_cols=357_143,
             fuse_clients=True)),
         ("sketch (FetchSGD, rho=0)", mk(
             "sketch_rho0", mode="sketch", error_type="virtual",
@@ -117,7 +122,8 @@ def _write(args, base, k, rows, real):
         f"local batch {base['local_batch_size']}, piecewise-linear lr "
         "TUNED PER MODE by scripts/r3_sweep.py (the FetchSGD paper tunes "
         "lr per compression config, §5; momentum modes need ~(1-rho)x the "
-        f"SGD lr — see accuracy_run.py). k={k}, sketch 5x500k. Produced by "
+        f"SGD lr — see accuracy_run.py). k={k}; sketch rows name their "
+        "r x c split (identical table bytes). Produced by "
         "`python scripts/accuracy_run.py` on one TPU v5e chip.",
         "",
         "| mode | lr (peak) | pivot ep | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
@@ -134,37 +140,17 @@ def _write(args, base, k, rows, real):
         "uncompressed baseline's accuracy at reduced upload bytes/round —",
         "compare the sketch rows against row 1 at the byte counts shown.",
     ]
-    if real or args.variant != "flat":
-        Path(args.out).write_text("\n".join(lines) + "\n")
-        print(f"wrote {args.out} ({len(rows)} rows)", flush=True)
-        return
-    # the analysis below is specific to the FLAT synthetic stand-in
-    lines += [
-        "",
-        "## Reading these numbers (r2 analysis)",
-        "",
-        "All five modes train STABLY (r2's CountSketch v5 banded layout fixed",
-        "an outright divergence — see ops/countsketch.py postmortem and",
-        "scripts/sketch_lab.py). The remaining sketch/true_topk accuracy gap",
-        "on THIS dataset is a property of global-top-k error feedback on the",
-        "synthetic stand-in, not of the sketch: an EXACT classic scatter",
-        "sketch under identical server algebra scores the same in the lab",
-        "(acc 0.315 vs 0.305/0.333 for v5 at 6 epochs), and single-shot",
-        "heavy-hitter recall on a real ResNet gradient here is only ~0.38 at",
-        "k=d/130 — the synthetic set's gradients are too FLAT for the",
-        "FetchSGD premise (real CIFAR gradients concentrate; the paper's",
-        "94%-at-iso-bytes result rides that structure). local_topk (exact",
-        "per-client top-k + local error feedback) does not depend on global",
-        "heavy hitters and reaches the best accuracy at 25x fewer upload",
-        "bytes than uncompressed. Momentum note: rho=0.9 amplifies the burst",
-        "dynamics on flat gradients (coordinates wait ~d/k rounds, then get",
-        "their whole momentum-scaled backlog in one lump) and stalls here,",
-        "while rho=0 reaches 0.66 at 2.6x fewer upload bytes — on real",
-        "CIFAR, heavy hitters extract every round and rho=0.9 behaves.",
-        "Re-run this script with real",
-        "cifar-10-batches-py under --dataset_dir for paper-comparable rows.",
-    ]
-    Path(args.out).write_text("\n".join(lines) + "\n")
+    # Preserve any hand-written analysis section in the existing file: the
+    # table is regenerated, the narrative (e.g. "## Reading these numbers
+    # (r3)" in ACCURACY.md) is NOT this script's to destroy.
+    out_path = Path(args.out)
+    marker = "\n## Reading these numbers"
+    if out_path.exists():
+        old = out_path.read_text()
+        cut = old.find(marker)
+        if cut != -1:
+            lines += ["", old[cut:].strip()]
+    out_path.write_text("\n".join(lines) + "\n")
     print(f"wrote {args.out} ({len(rows)} rows)", flush=True)
 
 

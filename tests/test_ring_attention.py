@@ -65,6 +65,8 @@ def test_ring_gradients_match_dense(seq_mesh):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-5)
 
 
+@pytest.mark.slow  # integration variant; the ring kernel's exactness
+# (fwd + grads) and the federated TP/SP round stay default-tier
 def test_sp_gpt2_forward_matches_dense(seq_mesh):
     cfg = GPT2Config(vocab_size=128, n_positions=T, n_embd=32, n_layer=2,
                      n_head=4, dtype=jnp.float32)

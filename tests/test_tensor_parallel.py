@@ -65,6 +65,7 @@ def test_tp_forward_matches_dense(axes):
     np.testing.assert_allclose(np.asarray(mc_t), np.asarray(mc_d), atol=3e-4)
 
 
+@pytest.mark.slow  # branch variant of test_tp_forward_matches_dense
 def test_tp_forward_no_mc_head():
     mesh = make_mesh(1, 2, 1)
     model, params, ids, tt, _ = _setup()

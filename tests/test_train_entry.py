@@ -20,7 +20,7 @@ def test_cv_train_femnist_end_to_end(tmp_path):
         num_clients=6,
         num_workers=4,
         num_devices=4,
-        local_batch_size=16,  # 1-core CPU budget: 11 rounds, not 44
+        local_batch_size=32,  # 1-core CPU budget: 5 rounds, not 44
         num_epochs=1,
         pivot_epoch=1,
         lr_scale=0.1,
@@ -32,6 +32,8 @@ def test_cv_train_femnist_end_to_end(tmp_path):
     assert 0.0 <= val["accuracy"] <= 1.0
 
 
+@pytest.mark.slow  # same path as test_cv_train_takes_device_data_path_e2e
+# (femnist, uncompressed, cv_main) which stays in the default tier
 def test_cv_train_uncompressed_single_worker(tmp_path):
     """BASELINE config #1: uncompressed, 1 worker, CPU-runnable."""
     val = cv_main(
@@ -68,6 +70,9 @@ def test_graft_dryrun_multichip_8():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # the pieces hold default-tier coverage separately:
+# fixup forward (test_models), imagenet loader (test_data), RRC augmenter
+# (test_imagenet_augment), cv_train e2e (femnist tests)
 def test_cv_train_imagenet_fixup_end_to_end(tmp_path):
     """BASELINE config #5 shape (shrunk): FixupResNet-50 on ImageNet via
     the real npy-cache path (a tiny 64-image cache written here —
