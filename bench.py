@@ -71,9 +71,11 @@ def _measure(cfg, n_rounds: int = 20) -> float:
     from commefficient_tpu.parallel import FederatedSession, make_mesh
 
     workers, batch = cfg.num_workers, cfg.local_batch_size
-    model = ResNet9(num_classes=10)
+    from commefficient_tpu.models.losses import model_dtype
+
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
     params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
-    loss_fn = classification_loss(model.apply)
+    loss_fn = classification_loss(model.apply, compute_dtype=cfg.compute_dtype)
     session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
 
     rng = np.random.default_rng(0)
@@ -144,6 +146,10 @@ def main():
             "uncompressed_fused": base.replace(
                 mode="uncompressed", error_type="none", virtual_momentum=0.0,
             ),
+            # r3 mixed precision: model fwd/bwd in bf16 (native MXU),
+            # master params / grads / sketch algebra stay f32 —
+            # lab-validated accuracy parity (CHANGELOG_r3)
+            "sketch_fused_bf16": base.replace(compute_dtype="bfloat16"),
         }
         for name, cfg in matrix.items():
             sps = _measure(cfg)

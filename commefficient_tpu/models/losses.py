@@ -17,6 +17,52 @@ import jax.numpy as jnp
 IGNORE_INDEX = -100  # masked-label sentinel, same convention as the reference
 
 
+def _resolve_compute_dtype(compute_dtype):
+    """Loss-boundary cast dtype for the three compute modes.
+
+    "mixed" (default) and "float32" cast NOTHING here — they differ at
+    MODEL CONSTRUCTION (the flax modules' ``dtype`` field: bf16 matmuls
+    for mixed, true f32 for float32; the entry points thread it via
+    ``model_dtype``). "bfloat16" additionally casts params (+ inputs) at
+    the loss boundary, which is what flips the parts the module dtype
+    cannot reach: the GPT-2 residual stream is set f32 by the f32 wte
+    GATHER and re-promoted at every residual add, keeping layernorms,
+    residuals, and the tied-head [*, E] x [E, V] matmul f32 under
+    "mixed" — measured 2.4x slower per GPT-2-small epoch than the full
+    bf16 stream (CHANGELOG_r3). ResNet-9 casts its stream at entry, so
+    "bfloat16" is speed-neutral there (bench-measured)."""
+    if compute_dtype in (None, "mixed", "float32", jnp.float32):
+        return None
+    if compute_dtype in ("bfloat16", jnp.bfloat16):
+        return jnp.bfloat16
+    raise ValueError(
+        f"compute_dtype must be mixed|float32|bfloat16, got {compute_dtype!r}"
+    )
+
+
+def model_dtype(compute_dtype):
+    """The flax module ``dtype`` for a Config.compute_dtype value."""
+    return jnp.float32 if compute_dtype == "float32" else jnp.bfloat16
+
+
+def _cast_floats(tree, dtype):
+    """Cast the float leaves of a pytree (params) to ``dtype``.
+
+    Mixed-precision convention: master params stay float32 in FedState;
+    the cast happens INSIDE the loss so ``jax.grad`` w.r.t. the f32 params
+    flows through the cast (its transpose casts the cotangent back to
+    f32). The forward/backward matmuls then run native-bf16 on the MXU
+    while gradients, compression, and the server update remain f32.
+    Cross-entropies compute in f32 regardless (softmax_cross_entropy_sum
+    upcasts logits)."""
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
 def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Mean CE over positions whose label != IGNORE_INDEX.
 
@@ -41,7 +87,7 @@ def softmax_cross_entropy_sum(logits: jnp.ndarray, labels: jnp.ndarray):
     return jnp.sum(nll * mask), jnp.sum(mask)
 
 
-def classification_loss(apply_fn, prep=None):
+def classification_loss(apply_fn, prep=None, compute_dtype=None):
     """Build the cv ``loss_fn``: batch = {"x": [B,H,W,C], "y": [B]}.
 
     Returns (mean CE, {"correct": #correct, "count": B}) — the worker eval
@@ -51,10 +97,17 @@ def classification_loss(apply_fn, prep=None):
     ``data.cifar.device_normalizer``: uint8 -> normalized float32). Keeping
     batches uint8 until this point quarters the host->TPU transfer — the
     train loop's measured bottleneck through a tunneled TPU.
+
+    ``compute_dtype="bfloat16"`` runs the model forward/backward in bf16
+    (see ``_cast_floats``; CE and all federated algebra stay f32).
     """
+    cd = _resolve_compute_dtype(compute_dtype)
 
     def loss_fn(params, batch, rng=None):
         x = batch["x"] if prep is None else prep(batch["x"])
+        if cd is not None:
+            params = _cast_floats(params, cd)
+            x = x.astype(cd)
         logits = apply_fn(params, x)
         loss = softmax_cross_entropy(logits, batch["y"])
         mask = batch["y"] != IGNORE_INDEX  # padded eval rows carry -100
@@ -67,15 +120,20 @@ def classification_loss(apply_fn, prep=None):
     return loss_fn
 
 
-def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0):
+def gpt2_double_heads_loss(apply_fn, lm_coef: float = 1.0, mc_coef: float = 1.0,
+                           compute_dtype=None):
     """Build the GPT-2 twin loss (gpt2_train.py ~L60-140).
 
     batch = {"input_ids": [B,N,T], "token_type_ids": [B,N,T],
              "lm_labels": [B,N,T] (-100 masked), "mc_token_ids": [B,N],
              "mc_labels": [B]} with N candidate continuations per dialog.
+    ``compute_dtype="bfloat16"``: see ``classification_loss``.
     """
+    cd = _resolve_compute_dtype(compute_dtype)
 
     def loss_fn(params, batch, rng=None):
+        if cd is not None:
+            params = _cast_floats(params, cd)
         lm_logits, mc_logits = apply_fn(
             params,
             batch["input_ids"],

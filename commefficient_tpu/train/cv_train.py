@@ -104,14 +104,17 @@ def build_model_and_data(cfg: Config):
     else:
         raise ValueError(f"unknown dataset {cfg.dataset_name!r}")
 
+    from commefficient_tpu.models.losses import model_dtype
+
+    mdt = model_dtype(cfg.compute_dtype)
     if cfg.model == "resnet9":
-        model = ResNet9(num_classes=num_classes)
+        model = ResNet9(num_classes=num_classes, dtype=mdt)
     elif cfg.model in ("fixup_resnet50", "resnet50"):
-        model = fixup_resnet50(num_classes=num_classes)
+        model = fixup_resnet50(num_classes=num_classes, dtype=mdt)
     else:
         raise ValueError(f"unknown model {cfg.model!r}")
     params = model.init(jax.random.key(cfg.seed), jnp.zeros(sample_shape))
-    loss_fn = classification_loss(model.apply, prep=prep)
+    loss_fn = classification_loss(model.apply, prep=prep, compute_dtype=cfg.compute_dtype)
     return train, test, real, model, params, loss_fn, augment
 
 

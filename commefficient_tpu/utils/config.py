@@ -137,6 +137,15 @@ class Config:
     # only the round's W participant rows across PCIe — required at GPT-2
     # scale where num_clients * D does not fit HBM.
     offload_client_state: bool = False
+    # Model compute precision: "mixed" (default — flax module matmuls
+    # bf16, params/residual-boundaries f32), "bfloat16" (params also cast
+    # at the loss boundary: the FULL stream incl. GPT-2 embeddings/
+    # residuals/tied head runs bf16 — 2.4x faster per GPT-2-small epoch,
+    # accuracy parity; see models/losses._resolve_compute_dtype), or
+    # "float32" (true f32 throughout — the reference's precision).
+    # Master params, gradients, compression, and the server update are
+    # f32 in every mode; cross-entropies compute f32.
+    compute_dtype: str = "mixed"
     # Sketch matmul dtype ("float32" | "bfloat16"). Measured r2: NO speed
     # or accuracy difference on v5e (default f32 matmul precision is
     # already bf16-pass and the round is not matmul-bound) — kept as an
@@ -200,6 +209,11 @@ class Config:
                 "not mask sketched momentum: use momentum_dampening=None/"
                 "False, or set allow_unstable_sketch_dampening=True for "
                 "parity experiments."
+            )
+        if self.compute_dtype not in ("mixed", "float32", "bfloat16"):
+            raise ValueError(
+                "compute_dtype must be mixed|float32|bfloat16, "
+                f"got {self.compute_dtype!r}"
             )
         if self.hash_family not in ("fmix32", "poly4"):
             raise ValueError(

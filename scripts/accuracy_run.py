@@ -142,10 +142,12 @@ def _write(args, base, k, rows, real):
     ]
     # Preserve any hand-written analysis section in the existing file: the
     # table is regenerated, the narrative (e.g. "## Reading these numbers
-    # (r3)" in ACCURACY.md) is NOT this script's to destroy.
+    # (r3)" in ACCURACY.md) is NOT this script's to destroy. Synthetic-run
+    # narratives must NOT leak into a real-data report, so a real-CIFAR
+    # run writes table-only (analyze it fresh).
     out_path = Path(args.out)
     marker = "\n## Reading these numbers"
-    if out_path.exists():
+    if out_path.exists() and not real:
         old = out_path.read_text()
         cut = old.find(marker)
         if cut != -1:

@@ -29,6 +29,7 @@ def main():
     ap.add_argument("--rho", type=float, default=0.9)
     ap.add_argument("--grid", default="0.4:2,0.2:6,0.1:6,0.04:6",
                     help="comma list of lr:pivot pairs")
+    ap.add_argument("--compute_dtype", default="float32")
     ap.add_argument("--num_rows", type=int, default=5)
     ap.add_argument("--num_cols", type=int, default=500_000)
     ap.add_argument("--k", type=int, default=50_000)
@@ -62,7 +63,7 @@ def main():
                 else 0.0
             ),
             k=k, num_rows=args.num_rows, num_cols=args.num_cols,
-            fuse_clients=True,
+            fuse_clients=True, compute_dtype=args.compute_dtype,
         )
         train, test, real, model, params, loss_fn, augment = (
             build_model_and_data(cfg)

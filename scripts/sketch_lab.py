@@ -48,6 +48,8 @@ def main():
                     help="synthetic stand-in: flat|concentrated")
     ap.add_argument("--mode", default="sketch",
                     help="sketch|uncompressed|true_topk|local_topk")
+    ap.add_argument("--compute_dtype", default="float32",
+                    help="model fwd/bwd dtype: float32 | bfloat16")
     ap.add_argument("--hash_family", default="fmix32",
                     help="fmix32 (production) | poly4 (4-universal "
                          "Mersenne-poly A/B backstop, VERDICT r2 item 7)")
@@ -71,10 +73,14 @@ def main():
     from commefficient_tpu.utils.config import Config
     from commefficient_tpu.utils.schedule import piecewise_linear_lr
 
-    model = ResNet9(num_classes=10, width=args.width)
+    from commefficient_tpu.models.losses import model_dtype
+
+    model = ResNet9(num_classes=10, width=args.width,
+                    dtype=model_dtype(args.compute_dtype))
     params = model.init(jax.random.key(42), jnp.zeros((1, 32, 32, 3)))
     loss_fn = classification_loss(
-        model.apply, prep=device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
+        model.apply, prep=device_normalizer(CIFAR10_MEAN, CIFAR10_STD),
+        compute_dtype=args.compute_dtype,
     )
     D = ravel_pytree(params)[0].size
     C, K = D // args.c_div, D // args.k_div

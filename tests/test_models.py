@@ -98,3 +98,36 @@ def test_gpt2_double_heads_shapes_and_loss():
     # grads flow to embeddings and mc head
     g, _ = ravel_params(grads)
     assert float(jnp.abs(g).sum()) > 0
+
+
+def test_compute_dtype_modes():
+    """The three compute modes are genuinely different graphs that agree
+    to bf16 resolution: "float32" (module dtype f32, true f32 compute)
+    vs "bfloat16" (module bf16 + loss-boundary param cast, full-bf16
+    stream). Grads w.r.t. the f32 master params come back f32 in both."""
+    from commefficient_tpu.models.losses import model_dtype
+
+    m_f32 = ResNet9(num_classes=10, width=8, dtype=model_dtype("float32"))
+    m_bf16 = ResNet9(num_classes=10, width=8, dtype=model_dtype("bfloat16"))
+    rng = jax.random.key(0)
+    x = jax.random.normal(rng, (8, 32, 32, 3))
+    y = jax.random.randint(rng, (8,), 0, 10)
+    params = m_f32.init(rng, x)  # param dtypes are f32 in every mode
+    batch = {"x": x, "y": y}
+    lf32 = classification_loss(m_f32.apply, compute_dtype="float32")
+    lbf16 = classification_loss(m_bf16.apply, compute_dtype="bfloat16")
+    (l32, _), g32 = jax.value_and_grad(lf32, has_aux=True)(params, batch)
+    (l16, _), g16 = jax.value_and_grad(lbf16, has_aux=True)(params, batch)
+    assert np.isfinite(float(l16))
+    # different precision paths must actually differ...
+    assert float(l16) != float(l32)
+    # ...but agree to bf16 resolution
+    assert abs(float(l16) - float(l32)) / abs(float(l32)) < 0.05
+    flat16, _ = jax.flatten_util.ravel_pytree(g16)
+    flat32, _ = jax.flatten_util.ravel_pytree(g32)
+    assert flat16.dtype == jnp.float32  # master-grad dtype preserved
+    cos = float(
+        jnp.vdot(flat16, flat32)
+        / (jnp.linalg.norm(flat16) * jnp.linalg.norm(flat32))
+    )
+    assert cos > 0.98, cos
