@@ -275,7 +275,7 @@ def tp_gpt2_apply(mesh, model, tp_params, input_ids, token_type_ids=None,
 
 
 def build_tp_flat_loss(cfg: GPT2Config, mesh, lm_coef: float = 1.0,
-                       mc_coef: float = 1.0):
+                       mc_coef: float = 1.0, compute_dtype=None):
     """A ``loss_fn(params, batch, rng)`` whose COMPUTE is sharded over the
     mesh's ``model`` (attention heads / MLP hidden) and ``seq`` (tokens,
     ring attention) axes while the params stay the round engine's replicated
@@ -328,8 +328,17 @@ def build_tp_flat_loss(cfg: GPT2Config, mesh, lm_coef: float = 1.0,
             )
         return out
 
+    from commefficient_tpu.models.losses import _cast_floats, _resolve_compute_dtype
+
+    cd = _resolve_compute_dtype(compute_dtype)
+
     def loss_fn(params, batch, rng=None):
         del rng
+        if cd is not None:
+            # full-bf16 stream (see losses._resolve_compute_dtype): cast
+            # the flat/param tree BEFORE the tp transform so embeddings,
+            # residual stream, and the tied head run bf16 too
+            params = _cast_floats(params, cd)
         tp = tp_transform_params(params, cfg)
         tp = {**tp, "blocks": _local_blocks(tp["blocks"])}
         shape = batch["input_ids"].shape  # [B, N, T]

@@ -12,8 +12,10 @@ Run-command parity examples:
       --num_rows 5 --num_cols 5000000 --virtual_momentum 0.9 \
       --error_type virtual --compute_dtype bfloat16 \
       --num_workers 8 --num_devices 8                        # BASELINE #4
-      # bfloat16: 2.4x faster per epoch at GPT-2-small scale, identical
-      # losses (CHANGELOG_r3 mixed-precision note)
+      # bfloat16: full-bf16 residual stream — accuracy parity, identical
+      # loss trajectories; speed-neutral at single-chip microbatches
+      # where the 124M-dim sketch dominates (CHANGELOG_r3 corrected
+      # measurement)
   python -m commefficient_tpu.train.gpt2_train --model gpt2_tiny \
       --num_epochs 2 --num_workers 2 --num_devices 1         # CPU smoke
 
@@ -311,7 +313,8 @@ def main(argv=None, **overrides):
         session = FederatedSession(
             cfg,
             params,
-            build_tp_flat_loss(gcfg, mesh, cfg.lm_coef, cfg.mc_coef),
+            build_tp_flat_loss(gcfg, mesh, cfg.lm_coef, cfg.mc_coef,
+                               compute_dtype=cfg.compute_dtype),
             mesh=mesh,
             eval_loss_fn=loss_fn,
             mask_batch=mask_gpt2,

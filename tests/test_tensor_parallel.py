@@ -85,7 +85,16 @@ def test_tp_rejects_indivisible_sequence():
         tp_gpt2_apply(mesh, model, tp, ids)
 
 
-def test_federated_tp_sp_round_matches_dp_oracle():
+@pytest.mark.parametrize(
+    "compute_dtype",
+    [
+        "mixed",
+        # bf16 variant pins the compute_dtype plumbing through
+        # build_tp_flat_loss; precision-looser compare, slow tier
+        pytest.param("bfloat16", marks=pytest.mark.slow),
+    ],
+)
+def test_federated_tp_sp_round_matches_dp_oracle(compute_dtype):
     """VERDICT r2 item 3 'done' criterion: a workers=2 x model=2 x seq=2
     federated SKETCH round trajectory matches the DP-only oracle — the TP/SP
     axes shard each client's loss compute without changing the compression
@@ -119,13 +128,15 @@ def test_federated_tp_sp_round_matches_dp_oracle():
         token_type_ids=jnp.asarray(sample["token_type_ids"][:1]),
         mc_token_ids=jnp.asarray(sample["mc_token_ids"][:1]),
     )
-    dense_loss = gpt2_double_heads_loss(model.apply)
+    dense_loss = gpt2_double_heads_loss(model.apply, compute_dtype=compute_dtype)
 
     def run(cfg):
         if cfg.model_axis > 1 or cfg.seq_axis > 1:
             mesh = make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis)
             sess = FederatedSession(
-                cfg, params, build_tp_flat_loss(gcfg, mesh), mesh=mesh,
+                cfg, params,
+                build_tp_flat_loss(gcfg, mesh, compute_dtype=compute_dtype),
+                mesh=mesh,
                 eval_loss_fn=dense_loss, mask_batch=mask_gpt2,
             )
         else:
@@ -140,9 +151,18 @@ def test_federated_tp_sp_round_matches_dp_oracle():
         return losses, np.asarray(sess.state.params_vec)
 
     oracle_losses, oracle_params = run(Config(**cfg_kw))
-    tp_losses, tp_params = run(Config(**cfg_kw, model_axis=2, seq_axis=2))
-    np.testing.assert_allclose(tp_losses, oracle_losses, rtol=2e-4, atol=2e-4)
-    np.testing.assert_allclose(tp_params, oracle_params, rtol=2e-3, atol=2e-4)
+    tp_losses, tp_params = run(
+        Config(**cfg_kw, model_axis=2, seq_axis=2, compute_dtype=compute_dtype)
+    )
+    # bf16: sharded reduction orders differ at bf16 resolution, so the
+    # trajectories track rather than match; the param atol additionally
+    # absorbs top-k selection-boundary flips (a coordinate extracted in
+    # one path and banked in the other — measured: ~3 of 32k params, abs
+    # diff < 7e-3, after 4 rounds)
+    lt = (2e-4, 2e-4) if compute_dtype == "mixed" else (2e-2, 2e-2)
+    pt = (2e-3, 2e-4) if compute_dtype == "mixed" else (5e-2, 1e-2)
+    np.testing.assert_allclose(tp_losses, oracle_losses, rtol=lt[0], atol=lt[1])
+    np.testing.assert_allclose(tp_params, oracle_params, rtol=pt[0], atol=pt[1])
 
 
 @pytest.mark.slow  # the federated composition below (dp oracle test) holds
