@@ -49,6 +49,8 @@ from commefficient_tpu.models.gpt2 import (
 )
 from commefficient_tpu.models.losses import (
     IGNORE_INDEX,
+    _cast_floats,
+    _resolve_compute_dtype,
     softmax_cross_entropy_sum,
 )
 from commefficient_tpu.parallel.mesh import MODEL, SEQ, WORKERS
@@ -327,8 +329,6 @@ def build_tp_flat_loss(cfg: GPT2Config, mesh, lm_coef: float = 1.0,
                 }
             )
         return out
-
-    from commefficient_tpu.models.losses import _cast_floats, _resolve_compute_dtype
 
     cd = _resolve_compute_dtype(compute_dtype)
 

@@ -150,10 +150,10 @@ def test_federated_tp_sp_round_matches_dp_oracle(compute_dtype):
             losses.append(float(np.asarray(m["loss"])))
         return losses, np.asarray(sess.state.params_vec)
 
+    # NB Config.compute_dtype is inert here — both sessions' precision
+    # comes from the loss closures built above
     oracle_losses, oracle_params = run(Config(**cfg_kw))
-    tp_losses, tp_params = run(
-        Config(**cfg_kw, model_axis=2, seq_axis=2, compute_dtype=compute_dtype)
-    )
+    tp_losses, tp_params = run(Config(**cfg_kw, model_axis=2, seq_axis=2))
     # bf16: sharded reduction orders differ at bf16 resolution, so the
     # trajectories track rather than match; the param atol additionally
     # absorbs top-k selection-boundary flips (a coordinate extracted in
