@@ -28,9 +28,10 @@ def _resolve_compute_dtype(compute_dtype):
     cannot reach: the GPT-2 residual stream is set f32 by the f32 wte
     GATHER and re-promoted at every residual add, keeping layernorms,
     residuals, and the tied-head [*, E] x [E, V] matmul f32 under
-    "mixed" — measured 2.4x slower per GPT-2-small epoch than the full
-    bf16 stream (CHANGELOG_r3). ResNet-9 casts its stream at entry, so
-    "bfloat16" is speed-neutral there (bench-measured)."""
+    "mixed" — an accuracy/memory distinction, measured SPEED-NEUTRAL at
+    single-chip microbatches (CHANGELOG_r3's corrected multi-epoch twin;
+    the initial 2.4x reading was compile/tunnel variance). ResNet-9 casts
+    its stream at entry, so "bfloat16" is a no-op there too."""
     if compute_dtype in (None, "mixed", "float32", jnp.float32):
         return None
     if compute_dtype in ("bfloat16", jnp.bfloat16):

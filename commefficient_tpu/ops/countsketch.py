@@ -94,6 +94,15 @@ def _poly4_eval(x: np.ndarray, coeffs: np.ndarray) -> np.ndarray:
     """((c0 x^3 + c1 x^2 + c2 x + c3) mod p) for uint64 x < p — Horner with
     every intermediate < 2^62, exact in uint64. 4-wise independent over the
     seed-random coefficients (degree-3 polynomial over GF(p))."""
+    # Exactness (every Horner product < 2^62) AND 4-universality both
+    # require inputs inside the field: x < p. A silent wrap here would
+    # degrade the guarantee class without failing loudly (ADVICE r3).
+    if x.size and int(x.max()) >= int(_MERSENNE_P):
+        raise ValueError(
+            f"poly4 hash input {int(x.max())} >= p=2^31-1; the 4-universal "
+            "family is only defined over GF(p) — use hash_family='fmix32' "
+            "at this scale"
+        )
     acc = np.zeros_like(x) + coeffs[0]
     for a in coeffs[1:]:
         acc = (acc * x + a) % _MERSENNE_P

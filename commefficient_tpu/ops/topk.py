@@ -69,6 +69,34 @@ def topk_threshold_dense(v: jnp.ndarray, k: int, iters: int = 32) -> jnp.ndarray
     return v * ((mag >= hi) & (mag > 0))
 
 
+def topk_threshold_sharded(v_local: jnp.ndarray, k: int, axis_name: str,
+                           iters: int = 32) -> jnp.ndarray:
+    """``topk_threshold_dense`` over a vector SHARDED along ``axis_name`` —
+    each device holds a [d/W] slice and returns its slice of the global
+    top-<=k selection. The bisection is identical; only the max and the
+    selection counts become collectives (one scalar pmax + one scalar psum
+    per iteration — nothing vector-sized crosses the ICI). Used by the
+    FSDP round (parallel/fsdp.py) to extract a globally-top-k update from
+    the sharded error vector without ever materializing [d] anywhere.
+    """
+    mag = jnp.abs(v_local)
+    hi0 = jax.lax.pmax(jnp.max(mag), axis_name)
+
+    def body(_, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        count = jax.lax.psum(jnp.sum(mag >= mid), axis_name)
+        too_many = count > k
+        return jnp.where(too_many, mid, lo), jnp.where(too_many, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, iters, body, (hi0 * 0.0, hi0))
+    # same degenerate-tie contract as the dense kernel (see its docstring)
+    hi = jnp.where(
+        jax.lax.psum(jnp.sum(mag >= hi), axis_name) > k, jnp.inf, hi
+    )
+    return v_local * ((mag >= hi) & (mag > 0))
+
+
 def mask_out_indices(v: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Zero the given coordinates — the error-feedback "forget what was sent"
     step (``Ve[hh]=0`` in fed_aggregator.py ~L440-480)."""

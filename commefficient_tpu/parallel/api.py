@@ -63,6 +63,7 @@ class FederatedSession:
         *,
         mesh=None,
         eval_loss_fn: Optional[Callable] = None,
+        eval_fn: Optional[Callable] = None,
         mask_batch: Callable = mask_classification,
     ):
         self.cfg = cfg
@@ -103,16 +104,37 @@ class FederatedSession:
                     "exact config with scripts/sketch_lab.py before a "
                     "long run."
                 )
-        self.state = init_state(cfg, vec, self.spec)
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
-        if cfg.offload_client_state:
-            if needs_client_vel(cfg):
-                self.host_vel = np.zeros((cfg.num_clients, self.grad_size), np.float32)
-            if needs_client_err(cfg):
-                self.host_err = np.zeros((cfg.num_clients, self.grad_size), np.float32)
-        self.round_fn = build_round_fn(cfg, loss_fn, unravel, self.mesh, self.spec)
-        self.eval_fn = build_eval_fn(eval_loss_fn or loss_fn, unravel, mask_batch)
+        if cfg.fsdp:
+            # FSDP round (parallel/fsdp.py): params + dense server state
+            # sharded [D/W] over the workers axis; state arrives committed
+            # to its per-leaf shardings, so the replicated device_put below
+            # must not touch it.
+            from commefficient_tpu.parallel.fsdp import (
+                build_fsdp_round_fn,
+                init_fsdp_state,
+            )
+
+            self.state = init_fsdp_state(cfg, vec, self.spec, self.mesh)
+            self.round_fn = build_fsdp_round_fn(
+                cfg, loss_fn, unravel, self.mesh, self.spec, d=self.grad_size
+            )
+        else:
+            self.state = init_state(cfg, vec, self.spec)
+            if cfg.offload_client_state:
+                if needs_client_vel(cfg):
+                    self.host_vel = np.zeros((cfg.num_clients, self.grad_size), np.float32)
+                if needs_client_err(cfg):
+                    self.host_err = np.zeros((cfg.num_clients, self.grad_size), np.float32)
+            self.round_fn = build_round_fn(cfg, loss_fn, unravel, self.mesh, self.spec)
+        # eval_fn: a prebuilt (params_vec, batch) -> metric-sums step — the
+        # TP/SP eval path (tensor.build_tp_eval_fn) when the model needs the
+        # model axis to fit; else the jit-replicated dense eval over
+        # eval_loss_fn (or the train loss).
+        self.eval_fn = eval_fn or build_eval_fn(
+            eval_loss_fn or loss_fn, unravel, mask_batch
+        )
         self._batch_sharding = worker_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
         # eval batches shard their rows over the WORKERS axis only (they
@@ -126,12 +148,15 @@ class FederatedSession:
         # SingleDeviceSharding inputs compiles a SECOND program whose
         # donated-output layout then persists — one whole extra XLA compile
         # (~30s for ResNet-9 through the tunnel, measured) buried in epoch 1.
-        self.state = jax.tree.map(
-            lambda a: jax.device_put(a, self._replicated)
-            if isinstance(a, jnp.ndarray)
-            else a,
-            self.state,
-        )
+        # (FSDP state is committed to its per-leaf shardings in
+        # init_fsdp_state already.)
+        if not cfg.fsdp:
+            self.state = jax.tree.map(
+                lambda a: jax.device_put(a, self._replicated)
+                if isinstance(a, jnp.ndarray)
+                else a,
+                self.state,
+            )
 
     # -- device-resident data (TPU-native; ships only indices per round) ---
     def maybe_attach_data(self, dataset, sampler, augment=None) -> bool:
@@ -142,6 +167,7 @@ class FederatedSession:
         if not (
             self.cfg.device_data
             and not self.cfg.offload_client_state
+            and not self.cfg.fsdp  # index round builds the replicated round
             and sampler.fusable
             and all(isinstance(v, np.ndarray) for v in dataset.data.values())
             and sum(v.nbytes for v in dataset.data.values())
@@ -273,8 +299,11 @@ class FederatedSession:
         # val pass (measured 21 s for a 2.5 s eval).
         outs = []
         valids = []
+        pv = self.state.params_vec
+        if self.cfg.fsdp:
+            pv = pv[: self.grad_size]  # drop the [Dp] shard padding once
         for b in batches:
-            outs.append(self.eval_fn(self.state.params_vec, self._put_eval_batch(b)))
+            outs.append(self.eval_fn(pv, self._put_eval_batch(b)))
             valids.append(float(np.asarray(b["_valid"])))
         if not outs:
             return {"loss": float("nan")}
@@ -312,7 +341,10 @@ class FederatedSession:
     # -- weights ----------------------------------------------------------
     @property
     def params(self):
-        return self.unravel(self.state.params_vec)
+        vec = self.state.params_vec
+        if self.cfg.fsdp:
+            vec = vec[: self.grad_size]
+        return self.unravel(vec)
 
     def bytes_per_round(self) -> Dict[str, int]:
         """Upload/download bytes per participating client (BASELINE.md

@@ -1,0 +1,313 @@
+"""FSDP-sharded federated round — params + dense server state over `workers`.
+
+SURVEY.md §7 maps the reference's ``ps_weights`` shm vector to a "replicated
+**(or FSDP-sharded)** param pytree"; the replicated round (parallel/round.py)
+realizes the first option, this module the second (VERDICT r3 missing 4).
+The memory wall it removes: at GPT-2 scale the replicated round keeps the
+[D] param vector PLUS dense momentum/error ([D] each in true_topk mode) on
+EVERY chip — ~3 x 124M floats before activations. Here every persistent [D]
+array is sharded into [D/W] slices over the ``workers`` mesh axis:
+
+  * params: each chip owns a contiguous [D/W] slice (D padded to W·⌈D/W⌉);
+    the round ``all_gather``s the full vector ONCE per round for the
+    forward/backward (a transient, like the activations), computes
+    per-client gradients shard-locally, and applies a SHARDED update.
+  * dense server momentum/error (uncompressed/true_topk): never
+    materialized — the per-worker gradient sums ``psum_scatter`` directly
+    into [D/W] slices (the reduce-scatter half of the all-reduce the
+    replicated round does), and all server algebra runs on slices.
+  * sketch-mode momentum/error live in [r, c] tables (small) and stay
+    replicated; what's sharded is the EXTRACTION: each chip estimates only
+    its own coordinate range (``estimate_at`` with offset-indexed global
+    hashes), the global top-k threshold is found with scalar-only
+    collectives (``ops.topk.topk_threshold_sharded``), and the error-sketch
+    subtraction uses each shard's slice sketch (``sketch_sparse`` at global
+    coordinates — by linearity the psum of slice sketches IS the sketch of
+    the full update). No [D] array exists outside the gradient transient.
+
+Parity contract: bit-close to the replicated round (same hashes, same
+estimates — the gather estimate path is bit-equal to the matmul path on
+CPU; summation orders differ in the reduce-scatter), pinned by
+tests/test_fsdp.py against the replicated oracle on the 8-device CPU mesh.
+
+Scope (validated in ``_validate_fsdp``): modes uncompressed / true_topk /
+sketch with server-side ("virtual"/none) state. local_topk and fedavg keep
+per-client [num_clients, D] state whose sharding story is
+``offload_client_state`` (host RAM), not FSDP; threshold top-k only (the
+sharded global selection is built on the threshold kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.ops.countsketch import (
+    CountSketch,
+    estimate_at,
+    sketch_sparse,
+    sketch_vec,
+)
+from commefficient_tpu.ops.topk import topk_threshold_sharded
+from commefficient_tpu.parallel.mesh import WORKERS
+from commefficient_tpu.parallel.round import (
+    FedState,
+    make_grad_one,
+    sum_client_grads,
+)
+from commefficient_tpu.utils.config import Config
+
+P = jax.sharding.PartitionSpec
+
+
+def _workers_size(mesh) -> int:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
+
+
+def padded_dim(d: int, n_shards: int) -> int:
+    return -(-d // n_shards) * n_shards
+
+
+def _validate_fsdp(cfg: Config) -> None:
+    if cfg.mode not in ("uncompressed", "true_topk", "sketch"):
+        raise NotImplementedError(
+            f"fsdp supports server-state modes (uncompressed/true_topk/"
+            f"sketch); mode={cfg.mode} keeps per-client [num_clients, D] "
+            "state — use offload_client_state for that memory wall"
+        )
+    if cfg.error_type == "local" or cfg.local_momentum > 0:
+        raise NotImplementedError("fsdp + local client state: see above")
+    if cfg.offload_client_state:
+        raise NotImplementedError("fsdp already shards server state; "
+                                  "offload_client_state targets local modes")
+    if cfg.topk_method != "threshold":
+        raise NotImplementedError(
+            "fsdp extraction uses the sharded threshold kernel; set "
+            "topk_method='threshold' (the default/fast path)"
+        )
+    if cfg.mode == "sketch" and cfg.momentum_dampening:
+        raise NotImplementedError(
+            "sketch momentum dampening is gated as unstable in the "
+            "replicated round already; not offered under fsdp"
+        )
+
+
+def _has_momentum(cfg: Config) -> bool:
+    return cfg.virtual_momentum > 0 or cfg.mode == "true_topk"
+
+
+def _has_error(cfg: Config) -> bool:
+    if cfg.mode == "sketch":
+        return cfg.error_type == "virtual"
+    return cfg.mode == "true_topk" and cfg.error_type == "virtual"
+
+
+def init_fsdp_state(
+    cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch], mesh
+) -> FedState:
+    """FedState with every [D] leaf padded to W·⌈D/W⌉ and device_put with
+    its FSDP sharding (params + dense momentum/error: P(workers); sketch
+    tables + step: replicated)."""
+    _validate_fsdp(cfg)
+    d = params_vec.shape[0]
+    dp = padded_dim(d, _workers_size(mesh))
+    f32 = jnp.float32
+    vec = jnp.pad(params_vec.astype(f32), (0, dp - d))
+    momentum: Any = ()
+    error: Any = ()
+    if cfg.mode == "sketch":
+        if cfg.virtual_momentum > 0:
+            momentum = jnp.zeros(spec.table_shape, f32)
+        if cfg.error_type == "virtual":
+            error = jnp.zeros(spec.table_shape, f32)
+    else:
+        if _has_momentum(cfg):
+            momentum = jnp.zeros((dp,), f32)
+        if _has_error(cfg):
+            error = jnp.zeros((dp,), f32)
+    state = FedState(
+        params_vec=vec, momentum=momentum, error=error,
+        client_vel=(), client_err=(), step=jnp.zeros((), jnp.int32),
+    )
+    shardings = fsdp_state_shardings(cfg, mesh)
+    return FedState(*[
+        jax.device_put(a, s) if isinstance(a, jnp.ndarray) else a
+        for a, s in zip(state, shardings)
+    ])
+
+
+def fsdp_state_shardings(cfg: Config, mesh) -> FedState:
+    """NamedSharding pytree matching ``init_fsdp_state``'s output — also
+    what a checkpoint restore must device_put against."""
+    shard = jax.sharding.NamedSharding(mesh, P(WORKERS))
+    repl = jax.sharding.NamedSharding(mesh, P())
+    dense = cfg.mode != "sketch"
+    return FedState(
+        params_vec=shard,
+        momentum=(shard if dense else repl) if _has_momentum(cfg) else (),
+        error=(shard if dense else repl) if _has_error(cfg) else (),
+        client_vel=(),
+        client_err=(),
+        step=repl,
+    )
+
+
+def per_chip_state_floats(cfg: Config, d: int, spec: Optional[CountSketch],
+                          n_shards: int) -> dict:
+    """The memory accounting the design claims: persistent per-chip floats
+    ~ D/W (+ small replicated sketch tables), vs the replicated round's
+    D * (1 + momentum + error)."""
+    dp = padded_dim(d, n_shards)
+    s = dp // n_shards
+    table = spec.table_shape[0] * spec.table_shape[1] if spec else 0
+    dense = cfg.mode != "sketch"
+    out = {"params": s}
+    out["momentum"] = (
+        (s if dense else table) if _has_momentum(cfg) else 0
+    )
+    out["error"] = (s if dense else table) if _has_error(cfg) else 0
+    out["total"] = sum(out.values())
+    out["replicated_equivalent"] = d * (
+        1 + (_has_momentum(cfg) and dense) + (_has_error(cfg) and dense)
+    ) + (table * ((_has_momentum(cfg) + _has_error(cfg)) if not dense else 0))
+    return out
+
+
+def build_fsdp_round_fn(
+    cfg: Config,
+    loss_fn: Callable,
+    unravel: Callable,
+    mesh,
+    spec: Optional[CountSketch] = None,
+    *,
+    d: int,
+):
+    """Compile the FSDP per-round step: same external contract as
+    ``build_round_fn``'s non-offloaded product — ``round_fn(state,
+    client_ids [W], batch {k: [W, ...]}, lr) -> (new_state, metrics)`` —
+    with ``state.params_vec`` (and dense momentum/error) sharded [Dp]
+    arrays instead of replicated [D] ones.
+    """
+    _validate_fsdp(cfg)
+    W = cfg.num_workers
+    nsh = _workers_size(mesh)
+    dp = padded_dim(d, nsh)
+    S = dp // nsh
+    f32 = jnp.float32
+    rho = cfg.virtual_momentum
+    has_m, has_e = _has_momentum(cfg), _has_error(cfg)
+    dampen = (
+        cfg.momentum_dampening
+        if cfg.momentum_dampening is not None
+        else cfg.mode != "sketch"
+    )
+    grad_one = make_grad_one(cfg, loss_fn, unravel)
+    fused = (
+        cfg.fuse_clients
+        and cfg.max_grad_norm is None
+        and cfg.dp_noise_multiplier == 0
+    )
+
+    def body(p_sh, m_in, e_in, batch, client_ids, rng, lr):
+        # ---- forward/backward on the gathered vector (transient [Dp]) ----
+        full = jax.lax.all_gather(p_sh, WORKERS, tiled=True)
+        params_vec = full[:d]
+        local, loss_local, aux = sum_client_grads(
+            grad_one, params_vec, batch, client_ids, rng, fused=fused
+        )
+        loss_mean = jax.lax.psum(loss_local, WORKERS) / W
+        aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)
+
+        # ---- sharded server update ---------------------------------------
+        my = jax.lax.axis_index(WORKERS)
+        idx = my * S + jnp.arange(S, dtype=jnp.int32)
+        in_range = (idx < d).astype(f32)
+        idx_c = jnp.minimum(idx, d - 1)
+
+        if cfg.mode == "sketch":
+            table = sketch_vec(spec, local)
+            agg = jax.lax.psum(table, WORKERS) / W
+            m = rho * m_in + agg if rho > 0 else agg
+            if cfg.error_type == "virtual":
+                e = e_in + lr * m
+                est = estimate_at(spec, e, idx_c) * in_range
+                upd = topk_threshold_sharded(est, cfg.k, WORKERS)
+                # linearity: psum of per-shard slice sketches == sketch of
+                # the full extracted update (zero-HH error feedback)
+                e = e - jax.lax.psum(sketch_sparse(spec, idx_c, upd), WORKERS)
+                delta_sh = upd
+            else:
+                e = e_in
+                est = estimate_at(spec, m, idx_c) * in_range
+                delta_sh = lr * topk_threshold_sharded(est, cfg.k, WORKERS)
+            new_m = m if rho > 0 else m_in
+            return p_sh - delta_sh, new_m, e, loss_mean, aux_sum
+
+        # dense modes: reduce-scatter straight into this chip's slice
+        agg_sh = (
+            jax.lax.psum_scatter(
+                jnp.pad(local, (0, dp - d)), WORKERS,
+                scatter_dimension=0, tiled=True,
+            )
+            / W
+        )
+        if cfg.mode == "true_topk":
+            m = rho * m_in + agg_sh
+            if cfg.error_type == "virtual":
+                e = e_in + lr * m
+                upd = topk_threshold_sharded(e, cfg.k, WORKERS)
+                e = e - upd  # Ve[hh] = 0
+                delta_sh = upd
+            else:
+                e = e_in
+                # dampening must mask on the UNSCALED selection (like the
+                # replicated round): at lr=0 (the schedule's final round)
+                # the scaled delta is all-zero but the selection is not
+                upd = topk_threshold_sharded(m, cfg.k, WORKERS)
+                delta_sh = lr * upd
+            if dampen:
+                m = jnp.where(upd != 0, 0.0, m)
+            return p_sh - delta_sh, m, e, loss_mean, aux_sum
+        # uncompressed
+        if rho > 0:
+            m = rho * m_in + agg_sh
+            delta_sh = lr * m
+        else:
+            m = m_in
+            delta_sh = lr * agg_sh
+        if cfg.do_topk_down:
+            # downlink compression: globally top-k the broadcast delta
+            delta_sh = topk_threshold_sharded(delta_sh, cfg.k, WORKERS)
+        return p_sh - delta_sh, m, e_in, loss_mean, aux_sum
+
+    dense = cfg.mode != "sketch"
+    m_spec = (P(WORKERS) if dense else P()) if has_m else P()
+    e_spec = (P(WORKERS) if dense else P()) if has_e else P()
+    shard = P(WORKERS)
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(shard, m_spec, e_spec, shard, shard, P(), P()),
+        out_specs=(shard, m_spec, e_spec, P(), P()),
+    )
+
+    def round_fn(state: FedState, client_ids, batch, lr):
+        rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
+        m = state.momentum if has_m else jnp.zeros((nsh,), f32)
+        e = state.error if has_e else jnp.zeros((nsh,), f32)
+        new_p, new_m, new_e, loss, aux = mapped(
+            state.params_vec, m, e, batch, client_ids, rng, lr
+        )
+        new_state = FedState(
+            params_vec=new_p,
+            momentum=new_m if has_m else (),
+            error=new_e if has_e else (),
+            client_vel=(),
+            client_err=(),
+            step=state.step + 1,
+        )
+        return new_state, {"loss": loss, **aux}
+
+    return jax.jit(round_fn, donate_argnums=(0,))

@@ -149,6 +149,58 @@ def _validate(cfg: Config) -> None:
         )
 
 
+def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable):
+    """Per-client gradient closure (the fed_worker forward_grad analog):
+    ``(params_vec, batch, noise_rng) -> (flat grad [D], loss, aux)`` with
+    weight decay, global-norm clip, and worker-side DP noise applied.
+    Shared by the replicated round (build_round_fn) and the FSDP round
+    (parallel/fsdp.py) so the gradient semantics can never drift."""
+    f32 = jnp.float32
+
+    def grad_one(params_vec, batch, noise_rng):
+        params = unravel(params_vec)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+        g, _ = ravel_pytree(grads)
+        g = g.astype(f32)
+        if cfg.weight_decay:
+            g = g + cfg.weight_decay * params_vec
+        g = clip_by_global_norm(g, cfg.max_grad_norm)
+        if cfg.dp_noise_multiplier > 0 and cfg.max_grad_norm is not None:
+            # worker-side DP: clip (above) + gaussian noise, fed_worker ~L380-420
+            sigma = cfg.dp_noise_multiplier * cfg.max_grad_norm
+            g = g + sigma * jax.random.normal(noise_rng, g.shape, f32)
+        return g, loss, aux
+
+    return grad_one
+
+
+def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *, fused: bool):
+    """(sum of client grads [D], loss sum, aux sum) over one shard's clients
+    — the NO-client-state aggregation shared by the replicated round's fused
+    fast path and the FSDP round (parallel/fsdp.py), extracted so the two
+    cannot drift. ``fused``: one flattened-batch grad replaces the per-client
+    vmap — identical math when nothing per-client is configured
+    (w_loc * flat-mean-grad == sum of per-client mean-grads)."""
+    w_loc = client_ids.shape[0]
+    if fused:
+        flat = jax.tree.map(
+            lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+            batch,
+        )
+        g, loss_flat, aux = grad_one(params_vec, flat, rng)
+        return w_loc * g, w_loc * loss_flat, aux
+
+    def per_client(b, cid):
+        return grad_one(params_vec, b, jax.random.fold_in(rng, cid))
+
+    gs, losses, auxes = jax.vmap(per_client)(batch, client_ids)
+    return (
+        jnp.sum(gs, axis=0),
+        jnp.sum(losses),
+        jax.tree.map(lambda a: jnp.sum(a, 0), auxes),
+    )
+
+
 def build_round_fn(
     cfg: Config,
     loss_fn: Callable,
@@ -210,19 +262,7 @@ def build_round_fn(
         _unsketch = partial(unsketch, approx=approx)
 
     # ---- per-client gradient (the fed_worker forward_grad analog) --------
-    def grad_one(params_vec, batch, noise_rng):
-        params = unravel(params_vec)
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-        g, _ = ravel_pytree(grads)
-        g = g.astype(f32)
-        if cfg.weight_decay:
-            g = g + cfg.weight_decay * params_vec
-        g = clip_by_global_norm(g, cfg.max_grad_norm)
-        if cfg.dp_noise_multiplier > 0 and cfg.max_grad_norm is not None:
-            # worker-side DP: clip (above) + gaussian noise, fed_worker ~L380-420
-            sigma = cfg.dp_noise_multiplier * cfg.max_grad_norm
-            g = g + sigma * jax.random.normal(noise_rng, g.shape, f32)
-        return g, loss, aux
+    grad_one = make_grad_one(cfg, loss_fn, unravel)
 
     def local_sgd_delta(params_vec, batches, noise_rng, lr):
         """fedavg: num_local_iters SGD steps on the client's microbatches
@@ -307,13 +347,9 @@ def build_round_fn(
 
         w_loc = client_ids.shape[0]
         if fused:
-            flat = jax.tree.map(
-                lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
-                batch,
+            local, loss_local, aux = sum_client_grads(
+                grad_one, params_vec, batch, client_ids, rng, fused=True
             )
-            g, loss_flat, aux = grad_one(params_vec, flat, rng)
-            local = w_loc * g  # == sum of the clients' mean-gradients
-            loss_local = w_loc * loss_flat
             new_vel = jnp.zeros((w_loc, 1), f32)
             new_err = jnp.zeros((w_loc, 1), f32)
         else:

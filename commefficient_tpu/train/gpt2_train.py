@@ -302,10 +302,15 @@ def main(argv=None, **overrides):
         # model/seq mesh axes (VERDICT r2 item 3): per-client loss compute
         # shards heads over `model` and tokens (ring attention) over `seq`
         # inside the round's shard_map; params/compression stay the
-        # replicated flat vector. Eval keeps the dense loss (it runs
-        # jit-replicated outside the shard_map).
+        # replicated flat vector. Eval is ALSO sharded over model/seq
+        # (VERDICT r3 missing 5: a model that needs the model axis to fit
+        # must be able to validate), via tensor.build_tp_eval_fn.
+        from commefficient_tpu.ops.param_utils import ravel_params
         from commefficient_tpu.parallel.mesh import make_mesh
-        from commefficient_tpu.parallel.tensor import build_tp_flat_loss
+        from commefficient_tpu.parallel.tensor import (
+            build_tp_eval_fn,
+            build_tp_flat_loss,
+        )
 
         mesh = make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis)
         print(f"mesh: workers={cfg.num_devices} x model={cfg.model_axis} "
@@ -316,7 +321,10 @@ def main(argv=None, **overrides):
             build_tp_flat_loss(gcfg, mesh, cfg.lm_coef, cfg.mc_coef,
                                compute_dtype=cfg.compute_dtype),
             mesh=mesh,
-            eval_loss_fn=loss_fn,
+            eval_fn=build_tp_eval_fn(
+                gcfg, mesh, ravel_params(params)[1], cfg.lm_coef,
+                cfg.mc_coef, compute_dtype=cfg.compute_dtype,
+            ),
             mask_batch=mask_gpt2,
         )
     else:

