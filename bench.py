@@ -36,6 +36,44 @@ import numpy as np
 # Used only as a fixed denominator so vs_baseline is comparable across rounds.
 BASELINE_SAMPLES_PER_SEC = 20_000.0
 
+# Peak dense matmul throughput of the bench chip, for the MFU line
+# (VERDICT r3 weak 5: anchor perf to hardware, not to the estimate above).
+# TPU v5e (v5 lite): 197 TFLOP/s bf16 / 394 int8 (public spec). The model
+# stream runs bf16 on the MXU in the default "mixed" mode, so bf16 peak is
+# the right denominator; a chip we don't recognize falls back to v5e's.
+PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v4": 275e12}
+
+
+def _chip_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    # longest key first: "TPU v5" must not shadow "TPU v5 lite" (v5e)
+    for name in sorted(PEAK_FLOPS, key=len, reverse=True):
+        if name in kind:
+            return PEAK_FLOPS[name]
+    return 197e12
+
+
+def resnet9_train_flops_per_sample() -> float:
+    """Analytic fwd+bwd FLOPs/sample for ResNet-9 at 32x32 (the model term
+    of the MFU line; sketch/top-k FLOPs are excluded, so sketch-mode MFU is
+    an UNDERestimate of chip utilization — the conservative direction).
+
+    Convs: 2*H*W*Cin*Cout*9 each; backward ~2x forward (dL/dx + dL/dW).
+    """
+    convs = [
+        (32, 3, 64),     # prep
+        (32, 64, 128),   # layer1 conv (pool after)
+        (16, 128, 128), (16, 128, 128),   # residual 1
+        (16, 128, 256),  # layer2 conv (pool after)
+        (8, 256, 512),   # layer3 conv (pool after)
+        (4, 512, 512), (4, 512, 512),     # residual 2
+    ]
+    fwd = sum(2 * h * h * cin * cout * 9 for h, cin, cout in convs)
+    fwd += 2 * 512 * 10  # head
+    return 3.0 * fwd  # fwd + ~2x for backward
+
 
 def _headline_cfg():
     from commefficient_tpu.utils.config import Config
@@ -158,8 +196,10 @@ def main():
                               "unit": "samples/s"}))
 
     headline = _measure(_headline_cfg())
+    mfu = headline * resnet9_train_flops_per_sample() / _chip_peak_flops()
     if args.matrix:
         rows["sketch_fused_headline"] = round(headline, 2)
+        rows["mfu_model_flops"] = round(mfu, 4)
         with open("BENCH_MATRIX.json", "w") as f:
             json.dump(rows, f, indent=2)
     print(
@@ -169,6 +209,10 @@ def main():
                 "value": round(headline, 2),
                 "unit": "samples/s",
                 "vs_baseline": round(headline / BASELINE_SAMPLES_PER_SEC, 4),
+                # model-FLOPs utilization: samples/s x analytic ResNet-9
+                # fwd+bwd FLOPs / chip bf16 peak — hardware-anchored, unlike
+                # vs_baseline's A100-class estimate (VERDICT r3 weak 5)
+                "mfu": round(mfu, 4),
             }
         )
     )

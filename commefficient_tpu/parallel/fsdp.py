@@ -237,6 +237,8 @@ def build_fsdp_round_fn(
                 # linearity: psum of per-shard slice sketches == sketch of
                 # the full extracted update (zero-HH error feedback)
                 e = e - jax.lax.psum(sketch_sparse(spec, idx_c, upd), WORKERS)
+                if cfg.error_decay != 1.0:
+                    e = cfg.error_decay * e
                 delta_sh = upd
             else:
                 e = e_in
@@ -259,6 +261,8 @@ def build_fsdp_round_fn(
                 e = e_in + lr * m
                 upd = topk_threshold_sharded(e, cfg.k, WORKERS)
                 e = e - upd  # Ve[hh] = 0
+                if cfg.error_decay != 1.0:
+                    e = cfg.error_decay * e
                 delta_sh = upd
             else:
                 e = e_in

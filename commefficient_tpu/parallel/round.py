@@ -388,6 +388,8 @@ def build_round_fn(
                 e = state.error + lr * m
                 update = _unsketch(spec, e, cfg.k)  # dense, ≤k nonzeros
                 e = e - sketch_vec(spec, update)  # zero HH (linearity)
+                if cfg.error_decay != 1.0:
+                    e = cfg.error_decay * e  # d/c-envelope mitigation
                 delta = update
             else:
                 e = state.error
@@ -406,6 +408,8 @@ def build_round_fn(
                 e = state.error + lr * m
                 update = _topk(e, cfg.k)
                 e = e - update  # Ve[hh] = 0
+                if cfg.error_decay != 1.0:
+                    e = cfg.error_decay * e
                 delta = update
             else:
                 e = state.error

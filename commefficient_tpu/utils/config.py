@@ -82,6 +82,13 @@ class Config:
     # settings (round.py warning; ~step 70 where unmasked converges). It is
     # kept only for parity experiments and must be opted into explicitly.
     allow_unstable_sketch_dampening: bool = False
+    # Virtual-error decay gamma: e <- gamma * e after each round's
+    # extract-and-subtract (sketch + true_topk virtual error). 1.0 (default,
+    # reference behavior) carries residual error indefinitely; < 1.0 leaks
+    # stale error mass — the d/c-envelope mitigation probed by the r4 lab
+    # (high d/c diverges through error-feedback SNR collapse; see
+    # CHANGELOG_r3 regime account and scripts/sketch_lab.py --error_decay).
+    error_decay: float = 1.0
 
     # --- model / dataset (reference: --model, --dataset_name,
     # --dataset_dir) ---
@@ -216,6 +223,12 @@ class Config:
                 "not mask sketched momentum: use momentum_dampening=None/"
                 "False, or set allow_unstable_sketch_dampening=True for "
                 "parity experiments."
+            )
+        if self.error_decay != 1.0 and self.error_type != "virtual":
+            raise ValueError(
+                "error_decay only acts on the server-side virtual error "
+                f"bank (error_type='virtual'); with error_type="
+                f"{self.error_type!r} it would be a silent no-op"
             )
         if self.compute_dtype not in ("mixed", "float32", "bfloat16"):
             raise ValueError(

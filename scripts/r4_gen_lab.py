@@ -1,0 +1,172 @@
+"""r4 generator lab — find a concentrated-variant parameterization that
+dense SGD can train to the label-noise ceiling (VERDICT r4 item 1, branch
+"fix the generator": 24-epoch tuned dense SGD caps at ~0.61 train-acc 0.56
+— underfitting — while local_topk fits to 0.93, so the stand-in fails to
+reproduce real-CIFAR's dense-SGD trainability).
+
+Mechanism under test: the rank-12 1/f background at pixel std 30 is a
+low-rank nuisance subspace with enormous per-direction variance; the stable
+lr is capped by those directions (divergence at lr>=1.2), starving the
+class-signal directions — a conditioning pathology that per-coordinate
+error-feedback methods (local_topk) sidestep.
+
+    python scripts/r4_gen_lab.py probe     # mechanism probes (bg ablation)
+    python scripts/r4_gen_lab.py one --bg_scale 10 --bg_rank 48 --lr 0.8
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_gen_lab.log"
+
+
+def run_one(name: str, gen_kw: dict, *, mode="uncompressed", lr=0.8,
+            pivot=6, epochs=24, k=50_000, seed=42, **cfg_kw):
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.data import FedDataset, augment_batch
+    from commefficient_tpu.data.cifar import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+        _synthetic_cifar_concentrated,
+        device_normalizer,
+    )
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.train.cv_train import (
+        build_session_and_sampler,
+        train_loop,
+    )
+    from commefficient_tpu.utils.config import Config
+    from commefficient_tpu.utils.logging import TableLogger
+
+    base = dict(
+        dataset_name="cifar10", model="resnet9", num_epochs=epochs,
+        num_clients=16, num_workers=8, num_devices=1, local_batch_size=64,
+        weight_decay=5e-4, seed=seed, topk_method="threshold",
+        lr_scale=lr, pivot_epoch=pivot,
+    )
+    if mode == "local_topk":
+        base.update(mode="local_topk", error_type="local", k=k)
+    elif mode == "sketch":
+        base.update(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                    k=k, fuse_clients=True)
+    else:
+        base.update(mode=mode, fuse_clients=True)
+    base.update(cfg_kw)
+    cfg = Config(**base)
+
+    train_d, test_d = _synthetic_cifar_concentrated(10, **gen_kw)
+    train = FedDataset(dict(train_d), cfg.num_clients, iid=True, seed=cfg.seed)
+    test = FedDataset(dict(test_d), 1, iid=True, seed=cfg.seed)
+    model = ResNet9(num_classes=10)
+    params = model.init(jax.random.key(cfg.seed), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(
+        model.apply, prep=device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
+    )
+    session, sampler = build_session_and_sampler(
+        cfg, train, params, loss_fn, augment_batch
+    )
+    t0 = time.time()
+    val = train_loop(cfg, session, sampler, test, table=TableLogger())
+    dt = time.time() - t0
+    line = (f"{name}: acc={val.get('accuracy', float('nan')):.4f} "
+            f"loss={val['loss']:.4f} ({dt:.0f}s) mode={mode} lr={lr}:{pivot} "
+            f"e{epochs} gen={gen_kw}")
+    print("==", line, flush=True)
+    LOG.parent.mkdir(exist_ok=True)
+    with LOG.open("a") as f:
+        f.write(line + "\n")
+    return val
+
+
+SUITES = {
+    # Mechanism: is the high-variance low-rank background what breaks dense
+    # SGD? bg=0 isolates it; the others test "keep a background but spread
+    # its variance" (higher rank at fixed total pixel std) and "shrink it".
+    # RESULT (runs/r4_gen_lab.log): bg0 0.8510 / bg10 0.7931 / rank96
+    # 0.6476 vs 0.6149 at bg30-rank12 — background variance IS the dense-
+    # SGD killer; spreading its rank barely helps.
+    "probe": [
+        ("bg0", dict(bg_scale=0.0)),
+        ("bg10", dict(bg_scale=10.0)),
+        ("bg30_rank96", dict(bg_rank=96)),
+    ],
+    # Tune on the reduced-background tasks (the probe lrs were tuned on
+    # bg30) + lower the irreducible-error knobs: patch_dropout 0.25 alone
+    # makes ~1.6% of samples patchless (unclassifiable) and interacts with
+    # cutout augmentation, so the honest ceiling sits below the label-noise
+    # ceiling the accuracy table quotes.
+    "tune": [
+        ("bg0_lr1.2", dict(bg_scale=0.0), dict(lr=1.2)),
+        ("bg0_mom_lr0.1", dict(bg_scale=0.0),
+         dict(lr=0.1, virtual_momentum=0.9)),
+        ("bg0_drop0.1", dict(bg_scale=0.0, patch_dropout=0.1), dict()),
+        ("bg5", dict(bg_scale=5.0), dict()),
+        ("bg10_mom_lr0.1", dict(bg_scale=10.0),
+         dict(lr=0.1, virtual_momentum=0.9)),
+        ("bg0_e48", dict(bg_scale=0.0), dict(epochs=48)),
+    ],
+    # v3 candidates: tune RESULT — dropout 0.25->0.1 recovers 5.5 pts
+    # (0.8510 -> 0.9059 at bg0); any background costs (bg5 0.83, bg10
+    # 0.79); momentum/longer-budget do NOT fix the background pathology
+    # (bg10_mom 0.789; bg0_e48 0.836 < bg0_e24 0.851). Candidates keep a
+    # small background if affordable, drop irreducibility, and test a
+    # stronger class signal.
+    "v3": [
+        ("bg5_drop0.1", dict(bg_scale=5.0, patch_dropout=0.1), dict()),
+        ("bg0_drop0.1_cs60", dict(bg_scale=0.0, patch_dropout=0.1,
+                                  class_scale=60.0), dict()),
+        ("bg5_drop0.1_cs60", dict(bg_scale=5.0, patch_dropout=0.1,
+                                  class_scale=60.0), dict()),
+        ("bg0_drop0.1_lr0.6", dict(bg_scale=0.0, patch_dropout=0.1),
+         dict(lr=0.6)),
+    ],
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("suite")
+    ap.add_argument("--mode", default="uncompressed")
+    ap.add_argument("--lr", type=float, default=0.8)
+    ap.add_argument("--pivot", type=int, default=6)
+    ap.add_argument("--epochs", type=int, default=24)
+    ap.add_argument("--bg_scale", type=float, default=None)
+    ap.add_argument("--bg_rank", type=int, default=None)
+    ap.add_argument("--class_scale", type=float, default=None)
+    ap.add_argument("--noise_scale", type=float, default=None)
+    ap.add_argument("--patches_per_class", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.suite == "one":
+        gen_kw = {
+            k: getattr(args, k)
+            for k in ("bg_scale", "bg_rank", "class_scale", "noise_scale",
+                      "patches_per_class")
+            if getattr(args, k) is not None
+        }
+        run_one(
+            f"{args.mode}_{args.lr}p{args.pivot}_e{args.epochs}_{gen_kw}",
+            gen_kw, mode=args.mode, lr=args.lr, pivot=args.pivot,
+            epochs=args.epochs,
+        )
+        return
+    for spec in SUITES[args.suite]:
+        name, gen_kw = spec[0], spec[1]
+        run_kw = dict(spec[2]) if len(spec) > 2 else {}
+        lr = run_kw.pop("lr", args.lr)
+        epochs = run_kw.pop("epochs", args.epochs)
+        run_kw.setdefault("mode", args.mode)
+        run_kw.setdefault("pivot", args.pivot)
+        run_one(name, gen_kw, lr=lr, epochs=epochs, **run_kw)
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,10 @@ def main():
     ap.add_argument("--m", type=int, default=None,
                     help="override the adaptive chunk size (d/c~100 regime "
                          "experiments)")
+    ap.add_argument("--error_decay", type=float, default=1.0,
+                    help="virtual-error decay gamma (d/c-envelope "
+                         "mitigation, r4): e <- gamma*e after each round's "
+                         "extract-and-subtract")
     args = ap.parse_args()
 
     import numpy as np
@@ -104,7 +108,7 @@ def main():
         fuse_clients=True, num_clients=16, num_workers=8, num_devices=1,
         local_batch_size=64, weight_decay=5e-4, seed=42,
         num_epochs=args.num_epochs, lr_scale=args.lr_scale,
-        pivot_epoch=args.pivot_epoch,
+        pivot_epoch=args.pivot_epoch, error_decay=args.error_decay,
     )
     session = FederatedSession(cfg, params, loss_fn)
     if session.spec is not None:

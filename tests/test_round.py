@@ -116,6 +116,31 @@ def test_local_topk_no_error_full_k_equals_uncompressed():
     np.testing.assert_allclose(_final_vec(st), _final_vec(su), atol=1e-5)
 
 
+def test_error_decay_zero_matches_no_error_sketch():
+    """error_decay (the r4 d/c-envelope mitigation knob) at gamma=0 drops
+    the whole carried error each round, which must reduce the virtual-error
+    sketch to the no-error sketch path: top-k selection is scale-invariant
+    and estimates are linear, so extracting from lr*m == lr * extracting
+    from m."""
+    kw = dict(mode="sketch", virtual_momentum=0.9, k=40, num_rows=3,
+              num_cols=120, topk_method="threshold", **BASE)
+    s_dec, l_dec = _run(Config(error_type="virtual", error_decay=0.0, **kw))
+    s_none, l_none = _run(Config(error_type="none", **kw))
+    np.testing.assert_allclose(l_dec, l_none, rtol=1e-4)
+    np.testing.assert_allclose(_final_vec(s_dec), _final_vec(s_none), atol=1e-5)
+
+
+def test_error_decay_shrinks_error_bank():
+    kw = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+              k=40, num_rows=3, num_cols=120, topk_method="threshold", **BASE)
+    s_full, _ = _run(Config(**kw), n_rounds=6)
+    s_dec, losses = _run(Config(error_decay=0.8, **kw), n_rounds=6)
+    assert np.all(np.isfinite(losses))
+    n_full = float(np.linalg.norm(np.asarray(s_full.state.error)))
+    n_dec = float(np.linalg.norm(np.asarray(s_dec.state.error)))
+    assert n_dec < n_full
+
+
 def test_fedavg_one_iter_equals_uncompressed():
     cfg_f = Config(mode="fedavg", num_local_iters=1, local_lr=0.1, **BASE)
     cfg_u = Config(mode="uncompressed", **BASE)
