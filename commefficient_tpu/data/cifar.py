@@ -90,7 +90,7 @@ def _synthetic_cifar_concentrated(
     num_classes: int, n_train: int = 50_000, n_test: int = 10_000, seed: int = 0,
     *,
     bg_rank: int = 12,
-    bg_scale: float = 30.0,
+    bg_scale: float = 5.0,
     patch: int = 12,
     patches_per_class: int = 3,
     class_scale: float = 42.0,
@@ -98,7 +98,7 @@ def _synthetic_cifar_concentrated(
     jitter_px: int = 2,
     noise_scale: float = 10.0,
     label_noise: float = 0.06,
-    patch_dropout: float = 0.25,
+    patch_dropout: float = 0.1,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
     """Synthetic CIFAR stand-in whose ResNet-9 gradients CONCENTRATE like
     real data's (r2 VERDICT item 1: the flat stand-in's uniform-random
@@ -125,6 +125,23 @@ def _synthetic_cifar_concentrated(
 
     Validated by ``scripts/grad_probe.py``: single-shot sketch recall@k on
     real ResNet-9 round gradients (the go/no-go gate before accuracy runs).
+
+    v3 parameterization (r4, VERDICT r3 missing 1): the defaults above are
+    the values DENSE SGD can train to the label-noise ceiling on. The
+    original r2/r3 values (``bg_scale=30, patch_dropout=0.25`` — variant
+    name "concentrated_v2") made tuned dense SGD plateau at 0.61 TRAIN acc
+    0.56 — underfitting, while local_topk fit to 0.93: the rank-12
+    background at pixel std 30 is a low-rank nuisance subspace whose
+    variance caps the stable lr (divergence at lr>=1.2) and starves the
+    class-signal directions; per-coordinate error-feedback methods
+    sidestep exactly that, so the v2 task couldn't reproduce real CIFAR's
+    dense-SGD trainability (94% in 24 epochs). Measured (24-epoch tuned
+    dense, runs/r4_gen_lab.log): bg30 0.615 / bg10 0.793 / bg5 0.831 /
+    bg0 0.851; patch_dropout 0.25 -> 0.1 recovers another ~5.5 pts (bg5+
+    drop0.1 = 0.8999 vs label-noise ceiling ~0.946). Momentum and longer
+    budgets do NOT fix the v2 pathology (bg10+mom 0.789; 48/72-epoch runs
+    REGRESS). bg_scale=5 keeps a real correlated-nuisance background at a
+    variance dense SGD tolerates.
     """
     rng = np.random.default_rng(seed)
     B = _pink_fields(rng, bg_rank)
@@ -362,9 +379,18 @@ def _load_cifar100(root: str) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarr
 def _synthetic_by_variant(num_classes: int, variant: str):
     if variant == "concentrated":
         return _synthetic_cifar_concentrated(num_classes)
+    if variant == "concentrated_v2":
+        # the r2/r3 parameterization, kept for reproducing those rounds'
+        # tables (dense-SGD-hostile — see _synthetic_cifar_concentrated)
+        return _synthetic_cifar_concentrated(
+            num_classes, bg_scale=30.0, patch_dropout=0.25
+        )
     if variant == "flat":
         return _synthetic_cifar(num_classes)
-    raise ValueError(f"unknown synthetic_variant {variant!r} (flat|concentrated)")
+    raise ValueError(
+        f"unknown synthetic_variant {variant!r} "
+        "(flat|concentrated|concentrated_v2)"
+    )
 
 
 def load_fed_cifar10(
@@ -380,8 +406,10 @@ def load_fed_cifar10(
 
     ``synthetic_variant`` picks the stand-in generator when the real pickles
     are absent: "flat" (legacy template+noise; gradient spectrum is
-    unrealistically flat) or "concentrated" (gradients concentrate like real
-    CIFAR's — the FetchSGD evidence runs use this, see ACCURACY.md)."""
+    unrealistically flat), "concentrated" (v3 — gradients concentrate like
+    real CIFAR's AND dense SGD trains to the ceiling; the FetchSGD evidence
+    runs use this, see ACCURACY.md), or "concentrated_v2" (the r2/r3
+    dense-SGD-hostile parameterization, kept to reproduce those tables)."""
     real = os.path.isdir(os.path.join(dataset_dir, "cifar-10-batches-py"))
     if real:
         train, test = _load_cifar10_batches(dataset_dir)

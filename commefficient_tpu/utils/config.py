@@ -239,10 +239,12 @@ class Config:
             raise ValueError(
                 f"hash_family must be fmix32|poly4, got {self.hash_family!r}"
             )
-        if self.synthetic_variant not in ("flat", "concentrated"):
+        if self.synthetic_variant not in (
+            "flat", "concentrated", "concentrated_v2"
+        ):
             raise ValueError(
-                "synthetic_variant must be flat|concentrated, "
-                f"got {self.synthetic_variant!r}"
+                "synthetic_variant must be flat|concentrated|"
+                f"concentrated_v2, got {self.synthetic_variant!r}"
             )
         if self.sketch_dtype not in ("float32", "bfloat16"):
             raise ValueError(

@@ -45,7 +45,7 @@ def main():
     ap.add_argument("--c_div", type=int, default=13, help="c = D / c_div")
     ap.add_argument("--k_div", type=int, default=130, help="k = D / k_div")
     ap.add_argument("--variant", default="flat",
-                    help="synthetic stand-in: flat|concentrated")
+                    help="synthetic stand-in: flat|concentrated|concentrated_v2")
     ap.add_argument("--mode", default="sketch",
                     help="sketch|uncompressed|true_topk|local_topk")
     ap.add_argument("--compute_dtype", default="float32",

@@ -116,6 +116,41 @@ def test_local_topk_no_error_full_k_equals_uncompressed():
     np.testing.assert_allclose(_final_vec(st), _final_vec(su), atol=1e-5)
 
 
+def test_envelope_warning_suggestion_converges():
+    """The d/c envelope warning's 'Raise num_cols to >=' advice must
+    actually clear the realized-width check when followed (review r4: a
+    requested-space suggestion can realize below the target)."""
+    import re
+    import warnings as _w
+
+    import flax.linen as nn
+
+    class Wide(nn.Module):  # d ~ 2.3M: realized widths track requests
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.Dense(8192)(x))
+
+    m = Wide()
+    params = m.init(jax.random.key(0), jnp.zeros((1, 256)))
+    loss_fn = classification_loss(m.apply)
+    kw = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+              k=16, num_rows=3, **{**BASE, "num_devices": 1})
+
+    def build(num_cols):
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            FederatedSession(Config(num_cols=num_cols, **kw), params, loss_fn)
+            return [str(x.message) for x in rec if "envelope" in str(x.message)]
+
+    first = build(20_000)  # d/c ~ 100: far outside the envelope
+    assert first, "expected the envelope warning to fire"
+    suggest = int(
+        re.search(r"Raise num_cols to >= ([\d,]+)", first[0])
+        .group(1).replace(",", "")
+    )
+    assert not build(suggest), "following the suggestion must clear the check"
+
+
 def test_error_decay_zero_matches_no_error_sketch():
     """error_decay (the r4 d/c-envelope mitigation knob) at gamma=0 drops
     the whole carried error each round, which must reduce the virtual-error
