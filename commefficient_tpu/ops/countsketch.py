@@ -255,10 +255,30 @@ class CountSketch(NamedTuple):
     # cluster uniformly over the chunks: residual same-chunk cluster mass
     # drops from ~cluster/s to ~block/s in >=3 rows simultaneously with
     # probability ~(block/s)^3 — classic-grade. Cost: one [nb, block]
-    # row-gather per sketch/estimate (~memcpy at block>=32, unlike the
-    # element-wise full permutation which costs ~50 ms at d=6.5M).
-    # 0 disables (pre-v4 layout).
-    scramble_block: int = 8
+    # row-gather per sketch/estimate — and the ROW SIZE of that gather is
+    # the sketch path's measured hot spot (r4, v5e, d=6.5M/c=500k: whole
+    # sketch_vec 14.9 ms at block=8 vs 7.9 ms at block=64; estimate_all
+    # 21.8 -> 15.2 ms — 8-float rows are a worst case for the TPU gather
+    # engine, 64-float rows ~2x faster end-to-end). block=64 keeps the
+    # splitting property comfortably: (block/s)^3 at the headline
+    # geometry (s=312) is ~0.9% per cluster, and the r4 stability checks
+    # (quarter-scale lab, full-scale 7x357k accuracy run, adversarial
+    # structured-input tests) hold at 64 — see CHANGELOG_r4. BUT a block
+    # must stay small relative to the CHUNK, or a tied contiguous cluster
+    # rides one block into one chunk and corrupts the median (the
+    # adversarial equal-magnitude test catches exactly this at lab m=64),
+    # so None (default) resolves adaptively via ``sblock``:
+    # min(64, max(8, chunk_m // 64)) — 64 at production chunk sizes
+    # (m=4096 CV, m=8192+ GPT-2), back to 8 at small-m lab geometries.
+    # Explicit int pins it; 0 disables (pre-v4 layout).
+    scramble_block: Any = None
+
+    @property
+    def sblock(self) -> int:
+        """Realized scramble block (see scramble_block field note)."""
+        if self.scramble_block is not None:
+            return self.scramble_block
+        return min(64, max(8, self.chunk_m // 64))
     # Banded buckets (v5). With disjoint per-chunk pools, a coordinate can
     # only ever collide inside its chunk's s (~300) buckets; FetchSGD's
     # error sketch accumulates STRUCTURED mass and the feedback loop
@@ -289,7 +309,7 @@ class CountSketch(NamedTuple):
     @property
     def d_eff(self) -> int:
         """Scrambled-space length: d padded to a block multiple."""
-        b = self.scramble_block
+        b = self.sblock
         return _ceil_mult(self.d, b) if b else self.d
 
     @property
@@ -450,9 +470,35 @@ def _scramble_perms(d_eff: int, block: int, seed: int):
     return sperm, inv
 
 
+def _median_rows(ests: jnp.ndarray) -> jnp.ndarray:
+    """Median over axis 0 of an [r, d] stack — min/max selection networks
+    for the common small odd r (r=3: 3 ops; r=5: 7 ops), else jnp.median.
+
+    jnp.median lowers to a full XLA sort: measured 4.9 ms net for [5, 6.5M]
+    on v5e where the 5-element network costs 2.8 ms (r4 perf probe). The
+    networks return exactly the middle element, bit-equal to jnp.median
+    for odd r (pinned by tests)."""
+    mn, mx = jnp.minimum, jnp.maximum
+    r = ests.shape[0]
+    if r == 1:
+        return ests[0]
+    if r == 3:
+        a, b, c = ests[0], ests[1], ests[2]
+        return mx(mn(a, b), mn(mx(a, b), c))
+    if r == 5:
+        a, b, c, d, e = ests[0], ests[1], ests[2], ests[3], ests[4]
+        a, b = mn(a, b), mx(a, b)
+        c, d = mn(c, d), mx(c, d)
+        a, c = mn(a, c), mx(a, c)  # a: min of {a,b,c,d}
+        b, d = mn(b, d), mx(b, d)  # d: max of {a,b,c,d}
+        b, c = mn(b, c), mx(b, c)  # median(all) = median of {b, c, e}
+        return mx(b, mn(c, e))
+    return jnp.median(ests, axis=0)
+
+
 def _scramble(spec: "CountSketch", v: jnp.ndarray) -> jnp.ndarray:
     """[d] -> [d_eff] scrambled (block-permuted) vector."""
-    b = spec.scramble_block
+    b = spec.sblock
     if not b:
         return v
     sperm, _ = _scramble_perms(spec.d_eff, b, spec.seed)
@@ -462,7 +508,7 @@ def _scramble(spec: "CountSketch", v: jnp.ndarray) -> jnp.ndarray:
 
 def _unscramble(spec: "CountSketch", v_s: jnp.ndarray) -> jnp.ndarray:
     """[d_eff] scrambled -> [d] original order."""
-    b = spec.scramble_block
+    b = spec.sblock
     if not b:
         return v_s[: spec.d]
     _, inv = _scramble_perms(spec.d_eff, b, spec.seed)
@@ -603,12 +649,12 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
     ests = jnp.stack(
         [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
     )
-    return _unscramble(spec, jnp.median(ests, axis=0))
+    return _unscramble(spec, _median_rows(ests))
 
 
 def _scrambled_pos(spec: CountSketch, idx: jnp.ndarray) -> jnp.ndarray:
     """Original coordinate index -> its position in scrambled space."""
-    b = spec.scramble_block
+    b = spec.sblock
     if not b:
         return idx
     _, inv = _scramble_perms(spec.d_eff, b, spec.seed)
@@ -656,7 +702,7 @@ def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.
         return table[row, cols] * sign
 
     ests = jnp.stack([one_row(r) for r in range(spec.r)])
-    return jnp.median(ests, axis=0)
+    return _median_rows(ests)
 
 
 def sketch_sparse(spec: CountSketch, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:

@@ -198,10 +198,12 @@ def build_fsdp_round_fn(
     f32 = jnp.float32
     rho = cfg.virtual_momentum
     has_m, has_e = _has_momentum(cfg), _has_error(cfg)
+    # same AUTO resolution as build_round_fn (r4 four-corner evidence):
+    # local modes aren't supported here, so AUTO is effectively False
     dampen = (
         cfg.momentum_dampening
         if cfg.momentum_dampening is not None
-        else cfg.mode != "sketch"
+        else cfg.mode == "local_topk"
     )
     grad_one = make_grad_one(cfg, loss_fn, unravel)
     fused = (

@@ -227,14 +227,22 @@ def build_round_fn(
         gathers/scatters the participants' rows around each call.
     """
     _validate(cfg)
-    # momentum masking (dampening): AUTO (None) resolves to True for the
-    # dense modes — the reference zeroes velocity at sent coords, and
-    # measured: unmasked true_topk momentum overshoots (acc decays 0.47 ->
-    # 0.10 over 24 epochs) — and False for sketch (FetchSGD Alg 1).
+    # momentum masking (dampening): AUTO (None) resolves per mode on the
+    # measured four-corner evidence (r4 lab, runs/r4_retune.log):
+    #   sketch     -> False  (FetchSGD Alg 1 does not mask sketched
+    #                 momentum; masking via noisy estimates diverges)
+    #   true_topk  -> False  (r4, v3 task, tuned lr per corner: unmasked
+    #                 0.8923 vs masked 0.8595 — the r1 "unmasked decays
+    #                 0.47 -> 0.10" overshoot was a property of the
+    #                 dense-SGD-hostile v2 task, not of the mode. The
+    #                 reference masks here; set momentum_dampening=True
+    #                 for exact reference behavior.)
+    #   local_topk -> True   (reference behavior; applies only with
+    #                 local momentum > 0; no contrary evidence)
     dampen = (
         cfg.momentum_dampening
         if cfg.momentum_dampening is not None
-        else cfg.mode != "sketch"
+        else cfg.mode == "local_topk"
     )
     if cfg.mode == "sketch" and dampen:
         import warnings

@@ -23,6 +23,23 @@ from commefficient_tpu.parallel.round import FedState
 from commefficient_tpu.utils.config import Config
 
 
+def _spec_fingerprint(spec) -> np.ndarray:
+    """The sketch-layout identity a checkpointed [r, c] table depends on.
+    Equal table SHAPES do not imply equal layouts (r4: the adaptive
+    scramble block changed the seed-derived permutation while shapes stayed
+    identical) — decoding a table with a different layout silently yields
+    garbage estimates, so restore refuses on mismatch."""
+    families = {"fmix32": 1, "poly4": 2}  # stable (str hash is per-process)
+    return np.asarray(
+        [
+            spec.d, spec.c, spec.r, spec.num_blocks, spec.seed,
+            spec.chunk_m, spec.sblock, spec.band, spec.d_eff,
+            spec.c_actual, families.get(spec.hash_family, 0),
+        ],
+        np.int64,
+    )
+
+
 def _to_saveable(session) -> dict:
     st = session.state
     out = {
@@ -32,6 +49,8 @@ def _to_saveable(session) -> dict:
         },
         "grad_size": session.grad_size,
     }
+    if session.spec is not None:
+        out["sketch_layout"] = _spec_fingerprint(session.spec)
     if session.host_vel is not None:
         out["host_vel"] = session.host_vel
     if session.host_err is not None:
@@ -94,9 +113,36 @@ class FedCheckpointer:
             return None
         import orbax.checkpoint as ocp
 
-        restored = self.mngr.restore(
-            step, args=ocp.args.StandardRestore(_to_saveable(session))
-        )
+        try:
+            restored = self.mngr.restore(
+                step, args=ocp.args.StandardRestore(_to_saveable(session))
+            )
+        except Exception as e:  # noqa: BLE001 — re-raise with provenance
+            if session.spec is not None and "sketch_layout" in str(e):
+                raise ValueError(
+                    "checkpoint predates the sketch-layout stamp (r4): its "
+                    "momentum/error tables may have been written under a "
+                    "different CountSketch layout (e.g. the pre-r4 "
+                    "scramble_block=8 default) and cannot be safely "
+                    "decoded. Re-train, or restore with a session whose "
+                    "CountSketch(scramble_block=...) matches the run that "
+                    "wrote the checkpoint."
+                ) from e
+            raise
+        if session.spec is not None and "sketch_layout" in restored:
+            want = _spec_fingerprint(session.spec)
+            got = np.asarray(restored["sketch_layout"])
+            if not np.array_equal(want, got):
+                raise ValueError(
+                    "checkpoint sketch layout != this session's: the "
+                    "[r, c] tables were written under a different "
+                    f"CountSketch geometry (stamp {got.tolist()} vs "
+                    f"{want.tolist()}; fields: d, c, r, num_blocks, seed, "
+                    "chunk_m, sblock, band, d_eff, c_actual, "
+                    "hash_family) — decoding them here would corrupt "
+                    "training silently. Match the spec (e.g. pin "
+                    "scramble_block) or re-train."
+                )
         if restored["grad_size"] != session.grad_size:
             raise ValueError(
                 f"checkpoint grad_size {restored['grad_size']} != model "

@@ -67,15 +67,14 @@ class Config:
     max_grad_norm: Optional[float] = None
     weight_decay: float = 5e-4
     # Zero momentum at the extracted/transmitted coordinates ("momentum
-    # masking"/dampening). None = AUTO: True for the dense modes
-    # (true_topk/local_topk — the reference's server and worker helpers
-    # zero velocity at sent coords; measured: unmasked momentum overshoots
-    # and true_topk decays from 0.47 to 0.10 over 24 epochs), False for
-    # sketch (FetchSGD Alg 1 does not mask sketched momentum, and masking
-    # via noisy estimates destabilizes — see round.py warning).
-    # NB the AUTO default flips behavior vs the r1 default of False for
-    # dense-mode configs that relied on unmasked momentum — set
-    # momentum_dampening=False explicitly to keep the old behavior.
+    # masking"/dampening). None = AUTO, resolved per mode on the
+    # r4 four-corner evidence (see round.py build_round_fn): local_topk ->
+    # True (reference behavior, applies with local momentum); true_topk ->
+    # False (r4: unmasked 0.8923 vs masked 0.8595 at tuned lr on the v3
+    # task — the earlier overshoot reading was a v2-task artifact; the
+    # reference masks here, so set True explicitly for exact reference
+    # behavior); sketch -> False (FetchSGD Alg 1; masking via noisy
+    # estimates destabilizes — see round.py warning).
     momentum_dampening: Optional[bool] = None
     # momentum_dampening=True with mode=sketch subtracts sketches of NOISY
     # momentum estimates every round and measurably diverges at paper-scale

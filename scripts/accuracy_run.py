@@ -26,6 +26,11 @@ def main():
     ap.add_argument("--num_epochs", type=int, default=8)
     ap.add_argument("--dataset_dir", default="./data")
     ap.add_argument("--out", default="ACCURACY.md")
+    ap.add_argument("--skip", type=int, default=0,
+                    help="crash resume: skip the first N runs and carry "
+                         "their rows over from the existing ACCURACY.md "
+                         "table (the axon tunnel can drop a compile "
+                         "mid-suite)")
     ap.add_argument("--variant", default="concentrated",
                     help="synthetic stand-in when real data absent: "
                          "flat|concentrated|concentrated_v2 (v2 = the "
@@ -57,14 +62,17 @@ def main():
     # r4: schedules re-tuned on the v3 concentrated task by
     # scripts/r4_retune.py (runs/r4_retune.log) — every grid single-peaked;
     # the v2-task optima transferred almost everywhere (sketch_rho0 and
-    # local_topk moved to 0.8, true_topk to 0.1).
+    # local_topk moved to 0.8; true_topk runs the unmasked-momentum corner
+    # whose tuned lr is 0.04 — see the four-corner ablation).
     sched = {
         "uncompressed": (0.8, piv),
         "uncompressed_mom": (0.06, piv),
         "sketch_rho09": (0.04, 2),
         "sketch_rho09_r7": (0.1, 2),
         "sketch_rho0": (0.8, piv),
-        "true_topk": (0.1, 2),
+        # AUTO dampening now resolves False for true_topk (r4 four-corner
+        # ablation) — tuned lr for the unmasked corner
+        "true_topk": (0.04, 2),
         "local_topk": (0.8, piv),
         "fedavg": (0.4, piv),
     }
@@ -97,9 +105,22 @@ def main():
         ("fedavg (4 local iters)", mk("fedavg", mode="fedavg", num_local_iters=4)),
     ]
 
+    pre_rows = []
+    if args.skip:
+        old = Path(args.out).read_text().splitlines()
+        tbl = [
+            l for l in old
+            if l.startswith("| ")
+            and not l.startswith("| mode")
+            and not l.startswith("|---")
+        ]
+        pre_rows = tbl[: args.skip]
+        assert len(pre_rows) == args.skip, (
+            f"--skip {args.skip} but only {len(pre_rows)} existing rows"
+        )
     rows = []
     real = None
-    for name, cfg in runs:
+    for name, cfg in runs[args.skip:]:
         train, test, real, model, params, loss_fn, augment = build_model_and_data(cfg)
         session, sampler = build_session_and_sampler(
             cfg, train, params, loss_fn, augment
@@ -113,10 +134,10 @@ def main():
                      val.get("accuracy", float("nan")), val["loss"], dt))
         print(f"== {name}: acc={rows[-1][5]:.4f} upload={bpr['upload_bytes']:,}B "
               f"({dt:.0f}s)", flush=True)
-        _write(args, base, k, rows, real)  # incremental: survive interruption
+        _write(args, base, k, rows, real, pre_rows)  # incremental
 
 
-def _write(args, base, k, rows, real):
+def _write(args, base, k, rows, real, pre_rows=()):
     label = "REAL CIFAR-10" if real else (
         f"SYNTHETIC CIFAR stand-in, variant={args.variant!r} (real pickles "
         "not on disk; numbers are pipeline/compression-quality evidence, "
@@ -126,7 +147,7 @@ def _write(args, base, k, rows, real):
         "",
         f"Data: {label}. {base['num_epochs']} epochs, 8 workers/round, "
         f"local batch {base['local_batch_size']}, piecewise-linear lr "
-        "TUNED PER MODE by scripts/r3_sweep.py (the FetchSGD paper tunes "
+        "TUNED PER MODE by scripts/r4_retune.py (the FetchSGD paper tunes "
         "lr per compression config, §5; momentum modes need ~(1-rho)x the "
         f"SGD lr — see accuracy_run.py). k={k}; sketch rows name their "
         "r x c split (identical table bytes). Produced by "
@@ -135,6 +156,7 @@ def _write(args, base, k, rows, real):
         "| mode | lr (peak) | pivot ep | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
         "|---|---|---|---|---|---|---|---|",
     ]
+    lines.extend(pre_rows)
     for name, lr, pv, up, down, acc, loss, dt in rows:
         lines.append(
             f"| {name} | {lr} | {pv} | {up:,} | {down:,} | "

@@ -104,9 +104,11 @@ def main():
               lambda s: jnp.sum(topk_threshold_dense(est + s, k)), n)
     scan_time("lax.top_k",
               lambda s: jnp.sum(jax.lax.top_k(jnp.abs(est + s), k)[0]), n)
-    from commefficient_tpu.ops.countsketch import _to_layout
-    scan_time("riffle layout only (row 2)",
-              lambda s: jnp.sum(_to_layout(spec, v + s, 2)), n)
+    from commefficient_tpu.ops.countsketch import _scramble, _to_layout
+    # _to_layout operates in scrambled space ([d_eff]) — feeding the raw
+    # [d] vector crashes whenever d % scramble_block != 0
+    scan_time("scramble + riffle layout (row 2)",
+              lambda s: jnp.sum(_to_layout(spec, _scramble(spec, v + s), 2)), n)
     scan_time("signs (mix32 iota)",
               lambda s: jnp.sum(spec._row_signs(1) * (v + s)), n)
 

@@ -1,0 +1,26 @@
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import time, numpy as np, jax, jax.numpy as jnp
+from commefficient_tpu.ops.countsketch import CountSketch, sketch_vec, estimate_all
+
+d = 6_573_130
+rng = np.random.default_rng(0)
+v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+
+def scan_time(name, stage, n=20):
+    @jax.jit
+    def run():
+        def body(s, _):
+            return stage(s * 1e-30).astype(jnp.float32) * 1e-30, ()
+        s, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+        return s
+    float(run())
+    t0 = time.perf_counter(); float(run())
+    print(f"{name:48s} {(time.perf_counter()-t0)/n*1e3:8.2f} ms", flush=True)
+
+for blk in (32, 64, 128):
+    spec = CountSketch(d=d, c=500_000, r=5, seed=42, scramble_block=blk)
+    table = jax.jit(lambda vv: sketch_vec(spec, vv))(v)
+    scan_time(f"sketch_vec blk={blk}", lambda s, sp=spec: jnp.sum(sketch_vec(sp, v + s)))
+    scan_time(f"estimate_all blk={blk}", lambda s, sp=spec, t=table: jnp.sum(estimate_all(sp, t + s)))

@@ -1,0 +1,26 @@
+#!/bin/bash
+# r4 d/c envelope sweep (VERDICT r3 weak 3 / next 5): map the 25-50 gap at
+# quarter scale and test error_decay as the mitigation at/past the cliff.
+# Each run ~2-4 min on one v5e chip; appends to runs/r4_envelope.log via tee.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+log() { echo "== $*" | tee -a runs/r4_envelope.log; }
+
+run() {
+  local name="$1"; shift
+  out=$(python scripts/sketch_lab.py --num_epochs 12 --lr_scale 0.04 \
+        --pivot_epoch 2 --virtual_momentum 0.9 "$@" 2>&1 | tail -2)
+  log "$name: $out"
+}
+
+# the gap: d/c in {25 (control), 30, 35, 40, 50 (known divergent)}
+for dc in 25 30 35 40 50; do
+  run "dc${dc}" --c_div "$dc" --k_div $((dc * 10))
+done
+# mitigation: error decay at the boundary and past it
+for dc in 35 40 50; do
+  for g in 0.95 0.9; do
+    run "dc${dc}_decay${g}" --c_div "$dc" --k_div $((dc * 10)) --error_decay "$g"
+  done
+done

@@ -1,0 +1,41 @@
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+import time, numpy as np, jax, jax.numpy as jnp
+from commefficient_tpu.ops.countsketch import CountSketch, sketch_vec, estimate_all
+
+d = 6_573_130
+rng = np.random.default_rng(0)
+v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+
+def scan_time(name, stage, n=20):
+    @jax.jit
+    def run():
+        def body(s, _):
+            return stage(s * 1e-30).astype(jnp.float32) * 1e-30, ()
+        s, _ = jax.lax.scan(body, jnp.float32(0), None, length=n)
+        return s
+    float(run())
+    t0 = time.perf_counter(); float(run())
+    print(f"{name:48s} {(time.perf_counter()-t0)/n*1e3:8.2f} ms", flush=True)
+
+est5 = jnp.asarray(rng.normal(size=(5, d)).astype(np.float32))
+def med5(x):
+    a, b, c, dd, e = x[0], x[1], x[2], x[3], x[4]
+    mn, mx = jnp.minimum, jnp.maximum
+    a, b = mn(a, b), mx(a, b)
+    c, dd = mn(c, dd), mx(c, dd)
+    a, c = mn(a, c), mx(a, c)
+    b, dd = mn(b, dd), mx(b, dd)
+    b, c = mn(b, c), mx(b, c)
+    return mx(b, mn(c, e))
+scan_time("jnp.median [5,d]", lambda s: jnp.sum(jnp.median(est5 + s, axis=0)))
+scan_time("median5 network", lambda s: jnp.sum(med5(est5 + s)))
+chk = np.asarray(med5(est5)); ref = np.asarray(jnp.median(est5, axis=0))
+print("network == jnp.median:", np.array_equal(chk, ref), flush=True)
+
+for blk in (8, 256):
+    spec = CountSketch(d=d, c=500_000, r=5, seed=42, scramble_block=blk)
+    table = jax.jit(lambda vv: sketch_vec(spec, vv))(v)
+    scan_time(f"sketch_vec blk={blk}", lambda s, sp=spec: jnp.sum(sketch_vec(sp, v + s)))
+    scan_time(f"estimate_all blk={blk}", lambda s, sp=spec, t=table: jnp.sum(estimate_all(sp, t + s)))

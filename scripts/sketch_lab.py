@@ -113,7 +113,7 @@ def main():
     session = FederatedSession(cfg, params, loss_fn)
     if session.spec is not None:
         print(f"spec: band={session.spec.band} V={session.spec.V_row(0)} "
-              f"s={session.spec.s} scramble_block={session.spec.scramble_block} "
+              f"s={session.spec.s} scramble_block={session.spec.sblock} "
               f"c_actual={session.spec.c_actual}")
     sampler = FedSampler(train, num_workers=8, local_batch_size=64, seed=42,
                          augment=augment_batch)

@@ -338,6 +338,20 @@ def test_poly4_contract(pspec):
     )
 
 
+@pytest.mark.parametrize("r", [1, 2, 3, 4, 5, 7])
+def test_median_rows_matches_jnp_median(r):
+    """The r4 min/max selection networks (estimate hot path) must be
+    bit-equal to jnp.median for every row count, including the even-r and
+    large-r fallback cases."""
+    from commefficient_tpu.ops.countsketch import _median_rows
+
+    rng = np.random.default_rng(r)
+    x = jnp.asarray(rng.normal(size=(r, 4097)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(_median_rows(x)), np.asarray(jnp.median(x, axis=0))
+    )
+
+
 def test_poly4_rejects_out_of_field_inputs():
     """The 4-universality and uint64-exactness arguments both require
     x < p = 2^31-1 (ADVICE r3): inputs at/past the field size must fail
