@@ -103,20 +103,26 @@ class FederatedSession:
                 # tests/test_round.py::test_envelope_warning_suggestion)
                 need_real = -(-self.grad_size // 25)
                 suggest = -(-need_real * 21 // 20)
+                decay_note = (
+                    "" if cfg.error_decay < 1.0 else
+                    " or set error_decay=0.9 (measured to extend the "
+                    "working envelope to realized d/c ~40: quarter-scale "
+                    "12-epoch runs at d/c 35/40 train fully with gamma=0.9 "
+                    "where undecayed runs sit at chance; d/c=50 is only "
+                    "partially salvaged — CHANGELOG_r4)"
+                )
                 warnings.warn(
                     f"sketch mode at realized d/c = "
                     f"{self.grad_size / c_real:.1f} (c_actual={c_real:,}) "
-                    "is OUTSIDE the measured-stable envelope: the r3/r4 "
-                    "labs measured d/c<=25 stable and d/c>=50 diverging "
-                    "for EVERY layout (exact classic sketch, global "
-                    "collision pools, 4-universal hashing — an error-"
-                    "feedback SNR property of the regime, not a layout or "
-                    "hash artifact; CHANGELOG_r3.md, CHANGELOG_r4.md). "
-                    f"Raise num_cols to >= {suggest:,}, consider "
-                    "error_decay<1 (the r4 envelope-mitigation knob — see "
-                    "CHANGELOG_r4 for its measured effect), or validate "
-                    "this exact config with scripts/sketch_lab.py before "
-                    "a long run."
+                    "is OUTSIDE the measured-stable envelope: the r4 sweep "
+                    "of the 25-50 gap puts the cliff between 25 (stable) "
+                    "and 30 (broken, acc ~chance) — for EVERY layout tried "
+                    "in r3/r4 (exact classic sketch, global collision "
+                    "pools, 4-universal hashing): an error-feedback SNR "
+                    "property of the regime, not a layout or hash artifact "
+                    "(CHANGELOG_r3.md, CHANGELOG_r4.md). Raise num_cols to "
+                    f">= {suggest:,}{decay_note}, or validate this exact "
+                    "config with scripts/sketch_lab.py before a long run."
                 )
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
