@@ -1,0 +1,27 @@
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from scripts.r4_gpt2_twin import run_one
+# d/c=40 + error_decay 0.9 at GPT-2 scale: 5 x 3.11M table (~8x upload
+# compression), the envelope-extension claim run for real.
+from commefficient_tpu.train import gpt2_train
+
+argv = [
+    "--model", "gpt2", "--dataset_dir", "./data",
+    "--num_epochs", "6", "--pivot_epoch", "2",
+    "--num_clients", "32", "--num_workers", "8",
+    "--num_devices", "1", "--local_batch_size", "4",
+    "--max_seq_len", "256", "--lr_scale", "0.32",
+    "--seed", "42", "--topk_method", "threshold",
+    "--mode", "sketch", "--error_type", "virtual", "--virtual_momentum", "0.9",
+    "--k", "50000", "--num_rows", "5", "--num_cols", "3111111",
+    "--fuse_clients", "true", "--error_decay", "0.9",
+]
+import json, time
+t0 = time.time()
+val = gpt2_train.main(argv)
+print("==", json.dumps({"config": "sketch 5x3.11M dc40 decay0.9 lr0.32",
+                        "nll": round(float(val["nll"]), 4),
+                        "ppl": round(float(val["ppl"]), 1),
+                        "mc_acc": round(float(val["mc_accuracy"]), 4),
+                        "seconds": round(time.time() - t0)}), flush=True)
