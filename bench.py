@@ -44,15 +44,18 @@ BASELINE_SAMPLES_PER_SEC = 20_000.0
 PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v4": 275e12}
 
 
-def _chip_peak_flops() -> float:
+def _chip_peak_flops() -> tuple[float, str, bool]:
+    """(peak bf16 FLOP/s, device_kind, fallback_used). ADVICE r4: an
+    unrecognized chip silently got v5e's peak and the MFU line was wrong
+    with no indication — now the kind and any fallback are reported."""
     import jax
 
     kind = jax.devices()[0].device_kind
     # longest key first: "TPU v5" must not shadow "TPU v5 lite" (v5e)
     for name in sorted(PEAK_FLOPS, key=len, reverse=True):
         if name in kind:
-            return PEAK_FLOPS[name]
-    return 197e12
+            return PEAK_FLOPS[name], kind, False
+    return 197e12, kind, True
 
 
 def resnet9_train_flops_per_sample() -> float:
@@ -196,26 +199,29 @@ def main():
                               "unit": "samples/s"}))
 
     headline = _measure(_headline_cfg())
-    mfu = headline * resnet9_train_flops_per_sample() / _chip_peak_flops()
+    peak, chip, assumed = _chip_peak_flops()
+    mfu = headline * resnet9_train_flops_per_sample() / peak
+    line = {
+        "metric": "fed_resnet9_sketch_train_samples_per_sec_per_chip",
+        "value": round(headline, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(headline / BASELINE_SAMPLES_PER_SEC, 4),
+        # model-FLOPs utilization: samples/s x analytic ResNet-9
+        # fwd+bwd FLOPs / chip bf16 peak — hardware-anchored, unlike
+        # vs_baseline's A100-class estimate (VERDICT r3 weak 5)
+        "mfu": round(mfu, 4),
+        "chip": chip,
+    }
+    if assumed:
+        # MFU denominator is a guess on this hardware — say so in-band
+        line["peak_flops_assumed"] = peak
     if args.matrix:
         rows["sketch_fused_headline"] = round(headline, 2)
         rows["mfu_model_flops"] = round(mfu, 4)
+        rows["chip"] = chip
         with open("BENCH_MATRIX.json", "w") as f:
             json.dump(rows, f, indent=2)
-    print(
-        json.dumps(
-            {
-                "metric": "fed_resnet9_sketch_train_samples_per_sec_per_chip",
-                "value": round(headline, 2),
-                "unit": "samples/s",
-                "vs_baseline": round(headline / BASELINE_SAMPLES_PER_SEC, 4),
-                # model-FLOPs utilization: samples/s x analytic ResNet-9
-                # fwd+bwd FLOPs / chip bf16 peak — hardware-anchored, unlike
-                # vs_baseline's A100-class estimate (VERDICT r3 weak 5)
-                "mfu": round(mfu, 4),
-            }
-        )
-    )
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

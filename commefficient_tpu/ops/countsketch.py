@@ -74,7 +74,7 @@ All functions are pure and jit/vmap/shard_map-friendly.
 from __future__ import annotations
 
 import functools as _functools
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -271,13 +271,25 @@ class CountSketch(NamedTuple):
     # min(64, max(8, chunk_m // 64)) — 64 at production chunk sizes
     # (m=4096 CV, m=8192+ GPT-2), back to 8 at small-m lab geometries.
     # Explicit int pins it; 0 disables (pre-v4 layout).
-    scramble_block: Any = None
+    scramble_block: Optional[int] = None
 
     @property
     def sblock(self) -> int:
         """Realized scramble block (see scramble_block field note)."""
         if self.scramble_block is not None:
-            return self.scramble_block
+            # ADVICE r4: a stray non-int (e.g. a float from a config sweep)
+            # would flow through sblock/d_eff layout arithmetic unchecked
+            # and corrupt the geometry silently — reject it here.
+            if (
+                not isinstance(self.scramble_block, (int, np.integer))
+                or isinstance(self.scramble_block, bool)
+            ):
+                raise TypeError(
+                    "scramble_block must be an int (got "
+                    f"{self.scramble_block!r}); it is layout arithmetic, "
+                    "not a tunable float"
+                )
+            return int(self.scramble_block)
         return min(64, max(8, self.chunk_m // 64))
     # Banded buckets (v5). With disjoint per-chunk pools, a coordinate can
     # only ever collide inside its chunk's s (~300) buckets; FetchSGD's

@@ -244,6 +244,18 @@ def build_round_fn(
         if cfg.momentum_dampening is not None
         else cfg.mode == "local_topk"
     )
+    if cfg.momentum_dampening is None and cfg.mode == "true_topk":
+        # ADVICE r4: AUTO here diverges from the reference's velocity-masking
+        # default (and has flipped across rounds) — surface it once so
+        # reference-parity runs notice rather than silently changing.
+        import warnings
+
+        warnings.warn(
+            "momentum_dampening=AUTO resolves to False for true_topk (r4 "
+            "four-corner evidence: unmasked 0.8923 vs masked 0.8595 at "
+            "tuned lr). The REFERENCE masks momentum here — pass "
+            "momentum_dampening=True explicitly for exact reference parity."
+        )
     if cfg.mode == "sketch" and dampen:
         import warnings
 

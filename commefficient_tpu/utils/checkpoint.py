@@ -103,6 +103,19 @@ class FedCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self.mngr.latest_step() if self.enabled else None
 
+    def _saved_lacks_sketch_layout(self, step: int, exc: Exception) -> bool:
+        """True if the on-disk checkpoint at ``step`` predates the r4
+        sketch-layout stamp. Probes the saved item structure (ADVICE r4:
+        orbax's exception text is not a stable interface); only if the
+        metadata probe itself fails does it fall back to matching the
+        exception text — worst case the raw orbax error propagates, which
+        still fails safe."""
+        try:
+            meta = self.mngr.item_metadata(step)
+            return "sketch_layout" not in meta
+        except Exception:  # noqa: BLE001 — probe is best-effort
+            return "sketch_layout" in str(exc)
+
     def restore(self, session, step: Optional[int] = None) -> Optional[int]:
         """Restore into ``session`` in place; returns the restored round
         index (== FedState.step) or None if nothing to restore."""
@@ -118,7 +131,9 @@ class FedCheckpointer:
                 step, args=ocp.args.StandardRestore(_to_saveable(session))
             )
         except Exception as e:  # noqa: BLE001 — re-raise with provenance
-            if session.spec is not None and "sketch_layout" in str(e):
+            if session.spec is not None and self._saved_lacks_sketch_layout(
+                step, e
+            ):
                 raise ValueError(
                     "checkpoint predates the sketch-layout stamp (r4): its "
                     "momentum/error tables may have been written under a "

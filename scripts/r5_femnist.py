@@ -1,0 +1,80 @@
+"""r5 BASELINE #3 redo (VERDICT r4 missing 2 / next-round item 2).
+
+The r4 FEMNIST table compared an untuned uncompressed baseline (lr fixed
+at local_topk's 0.2) against local_topk memorizing a ceiling-free stand-in
+to 1.0000. This redo applies the repo's own methodology:
+
+  * the stand-in now carries 6% within-client label noise (Bayes ceiling
+    ~0.947 — data/emnist.py), so nothing can report 1.0000;
+  * PER-MODE lr tuning with the doubling-grid protocol (r4_retune.py),
+    extended past any edge optimum;
+  * the final table quotes each mode at ITS OWN tuned lr, with the full
+    grids appended for audit.
+
+    python scripts/r5_femnist.py grid            # both modes, doubling grid
+    python scripts/r5_femnist.py one --mode local_topk --lr 0.4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ROOT = Path(__file__).resolve().parent.parent
+LOG = ROOT / "runs" / "r5_femnist.log"
+
+MODES = {
+    "local_topk": ["--mode", "local_topk", "--error_type", "local",
+                   "--k", "20000"],
+    "uncompressed": ["--mode", "uncompressed", "--fuse_clients", "true"],
+}
+
+
+def run_one(mode: str, lr: float, *, epochs=20, seed=42):
+    from commefficient_tpu.train import cv_train
+
+    t0 = time.time()
+    val = cv_train.main([
+        "--dataset_name", "femnist", "--model", "resnet9",
+        "--num_clients", "100", "--num_workers", "8",
+        "--num_devices", "1", "--local_batch_size", "16",
+        "--num_epochs", str(epochs), "--lr_scale", str(lr),
+        "--pivot_epoch", str(max(2, epochs // 4)),
+        "--topk_method", "threshold", "--dataset_dir", "./data",
+        "--weight_decay", "5e-4", "--seed", str(seed),
+    ] + MODES[mode])
+    dt = time.time() - t0
+    rec = {"mode": mode, "lr": lr, "epochs": epochs,
+           "acc": round(float(val.get("accuracy", float("nan"))), 4),
+           "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
+    print("==", json.dumps(rec), flush=True)
+    LOG.parent.mkdir(exist_ok=True)
+    with LOG.open("a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("cmd", choices=["grid", "one"])
+    ap.add_argument("--mode", default="local_topk")
+    ap.add_argument("--lr", type=float, default=0.2)
+    ap.add_argument("--epochs", type=int, default=20)
+    args = ap.parse_args()
+
+    if args.cmd == "one":
+        run_one(args.mode, args.lr, epochs=args.epochs)
+        return
+    # doubling grids; extend manually past any edge optimum (`one`)
+    for mode in ("uncompressed", "local_topk"):
+        for lr in (0.05, 0.1, 0.2, 0.4, 0.8):
+            run_one(mode, lr, epochs=args.epochs)
+
+
+if __name__ == "__main__":
+    main()
