@@ -244,7 +244,12 @@ def build_round_fn(
         if cfg.momentum_dampening is not None
         else cfg.mode == "local_topk"
     )
-    if cfg.momentum_dampening is None and cfg.mode == "true_topk":
+    if (
+        cfg.momentum_dampening is None
+        and cfg.mode == "true_topk"
+        and (cfg.virtual_momentum > 0 or cfg.local_momentum > 0)
+    ):
+        # (at zero momentum masking is a no-op — nothing to warn about)
         # ADVICE r4: AUTO here diverges from the reference's velocity-masking
         # default (and has flipped across rounds) — surface it once so
         # reference-parity runs notice rather than silently changing.

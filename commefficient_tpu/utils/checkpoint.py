@@ -134,14 +134,21 @@ class FedCheckpointer:
             if session.spec is not None and self._saved_lacks_sketch_layout(
                 step, e
             ):
+                # NB the stamp's absence is the LIKELY cause, not a certain
+                # one (review r5: a pre-stamp checkpoint can also fail for
+                # an unrelated reason, e.g. a truncated write) — so the
+                # original failure rides along in the message and as
+                # __cause__.
                 raise ValueError(
-                    "checkpoint predates the sketch-layout stamp (r4): its "
-                    "momentum/error tables may have been written under a "
-                    "different CountSketch layout (e.g. the pre-r4 "
-                    "scramble_block=8 default) and cannot be safely "
-                    "decoded. Re-train, or restore with a session whose "
+                    "restore failed and the checkpoint lacks the "
+                    "sketch-layout stamp (pre-r4): its momentum/error "
+                    "tables may have been written under a different "
+                    "CountSketch layout (e.g. the pre-r4 scramble_block=8 "
+                    "default) and cannot be safely decoded. Re-train, or "
+                    "restore with a session whose "
                     "CountSketch(scramble_block=...) matches the run that "
-                    "wrote the checkpoint."
+                    "wrote the checkpoint. (If the layout is not the "
+                    f"problem, the underlying failure was: {e})"
                 ) from e
             raise
         if session.spec is not None and "sketch_layout" in restored:

@@ -1,0 +1,17 @@
+"""Shared helpers for the r5 lab scripts (review r5: the JSON-record
+logging block was copy-pasted per script)."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def log_json(log_path: Path, rec: dict) -> None:
+    """Print a run record and append it to the suite's log file."""
+    print("==", json.dumps(rec), flush=True)
+    log_path.parent.mkdir(exist_ok=True)
+    with log_path.open("a") as f:
+        f.write(json.dumps(rec) + "\n")

@@ -1,0 +1,23 @@
+#!/bin/bash
+# r5 held-out validation of the fitted d/c envelope model
+# (parallel/envelope.py; VERDICT r4 item 6). The model was fitted ONLY to
+# the r4 sweep's gamma in {1, 0.95, 0.9}; these three points test its
+# predictions at gammas it never saw:
+#   gamma=0.925 -> rho* ~ 39.8  => d/c 35 should TRAIN, d/c 45 should FAIL
+#   gamma=0.85  -> rho* ~ 55.4  => d/c 50 should TRAIN
+# Same harness/geometry as r4 (k/c=0.1, rho=0.9, 12-epoch quarter-scale).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p runs
+log() { echo "== $*" | tee -a runs/r5_envelope_heldout.log; }
+
+run() {
+  local name="$1"; shift
+  out=$(python scripts/sketch_lab.py --num_epochs 12 --lr_scale 0.04 \
+        --pivot_epoch 2 --virtual_momentum 0.9 "$@" 2>&1 | tail -2)
+  log "$name: $out"
+}
+
+run "dc35_decay0.925_predict_TRAIN" --c_div 35 --k_div 350 --error_decay 0.925
+run "dc45_decay0.925_predict_FAIL"  --c_div 45 --k_div 450 --error_decay 0.925
+run "dc50_decay0.85_predict_TRAIN"  --c_div 50 --k_div 500 --error_decay 0.85

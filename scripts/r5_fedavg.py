@@ -9,13 +9,16 @@ per round it uploads once where plain SGD uploads L times.
 
 Design (iso-steps / iso-bytes triad, per-config tuned lr, honest-CV task
 — the v3 concentrated CIFAR stand-in where dense SGD demonstrably trains
-to 0.8999, so differences are measurable):
+to 0.8999, so differences are measurable). NB the fedavg microbatch
+convention: a round consumes ``num_local_iters * local_batch_size``
+samples per client (cv_train reshapes to [W, L, B]), so the fedavg leg
+sets local_batch_size=16 to hold 64 samples/client/round across the triad:
 
-  * fedavg      B=64, L=4 local steps (microbatch 16), E epochs
+  * fedavg      L=4 steps x microbatch 16 = 64 samples/round
                 -> R rounds, R uploads, 4R local steps
-  * iso-steps   uncompressed B=16, E epochs
+  * iso-steps   uncompressed B=16, 1 step x 16 samples/round
                 -> 4R rounds, 4R uploads, 4R steps (same minibatch 16)
-  * iso-bytes   uncompressed B=64, E epochs
+  * iso-bytes   uncompressed B=64, 1 step x 64 samples/round
                 -> R rounds, R uploads, R steps (batch 64 each)
 
 fedavg "wins" if it beats iso-bytes (same uploads, more local work) while
@@ -29,59 +32,43 @@ approaching iso-steps (same optimization work, 4x the uploads).
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-ROOT = Path(__file__).resolve().parent.parent
-LOG = ROOT / "runs" / "r5_fedavg.log"
+from labutil import log_json
 
-# (mode flags, local_batch_size) per triad leg
+LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_fedavg.log"
+
+# (mode flags, local_batch_size) per triad leg — see module docstring for
+# the samples/round accounting behind each batch size
 CONFIGS = {
-    "fedavg": (["--mode", "fedavg", "--num_local_iters", "4"], 64),
+    "fedavg": (["--mode", "fedavg", "--num_local_iters", "4"], 16),
     "iso_steps": (["--mode", "uncompressed", "--fuse_clients", "true"], 16),
     "iso_bytes": (["--mode", "uncompressed", "--fuse_clients", "true"], 64),
 }
 
-
-def run_cifar(config: str, lr: float, *, epochs=24, seed=42):
-    from commefficient_tpu.train import cv_train
-
-    mode_kw, batch = CONFIGS[config]
-    t0 = time.time()
-    val = cv_train.main([
+TASKS = {
+    "cifar_v3": [
         "--dataset_name", "cifar10", "--model", "resnet9",
-        "--synthetic_variant", "concentrated",
-        "--num_clients", "16", "--num_workers", "8", "--num_devices", "1",
-        "--local_batch_size", str(batch),
-        "--num_epochs", str(epochs), "--lr_scale", str(lr),
-        "--pivot_epoch", str(max(2, epochs // 4)),
-        "--topk_method", "threshold", "--dataset_dir", "./data",
-        "--weight_decay", "5e-4", "--seed", str(seed), "--iid", "true",
-    ] + mode_kw)
-    dt = time.time() - t0
-    rec = {"task": "cifar_v3", "config": config, "lr": lr, "epochs": epochs,
-           "batch": batch,
-           "acc": round(float(val.get("accuracy", float("nan"))), 4),
-           "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
-    print("==", json.dumps(rec), flush=True)
-    LOG.parent.mkdir(exist_ok=True)
-    with LOG.open("a") as f:
-        f.write(json.dumps(rec) + "\n")
-    return rec
-
-
-def run_imagenet(config: str, lr: float, *, epochs=12, seed=42):
-    from commefficient_tpu.train import cv_train
-
-    mode_kw, batch = CONFIGS[config]
-    t0 = time.time()
-    val = cv_train.main([
+        "--synthetic_variant", "concentrated", "--iid", "true",
+    ],
+    "imagenet": [
         "--dataset_name", "imagenet", "--model", "fixup_resnet50",
         "--num_classes", "100",
+    ],
+}
+
+
+def run(task: str, config: str, lr: float, *, epochs=24, seed=42):
+    from commefficient_tpu.train import cv_train
+
+    mode_kw, batch = CONFIGS[config]
+    t0 = time.time()
+    val = cv_train.main(TASKS[task] + [
         "--num_clients", "16", "--num_workers", "8", "--num_devices", "1",
         "--local_batch_size", str(batch),
         "--num_epochs", str(epochs), "--lr_scale", str(lr),
@@ -90,15 +77,12 @@ def run_imagenet(config: str, lr: float, *, epochs=12, seed=42):
         "--weight_decay", "5e-4", "--seed", str(seed),
     ] + mode_kw)
     dt = time.time() - t0
-    rec = {"task": "imagenet", "config": config, "lr": lr, "epochs": epochs,
-           "batch": batch,
-           "acc": round(float(val.get("accuracy", float("nan"))), 4),
-           "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
-    print("==", json.dumps(rec), flush=True)
-    LOG.parent.mkdir(exist_ok=True)
-    with LOG.open("a") as f:
-        f.write(json.dumps(rec) + "\n")
-    return rec
+    log_json(LOG, {
+        "task": task, "config": config, "lr": lr, "epochs": epochs,
+        "batch": batch,
+        "acc": round(float(val.get("accuracy", float("nan"))), 4),
+        "loss": round(float(val["loss"]), 4), "seconds": round(dt),
+    })
 
 
 def main():
@@ -107,31 +91,30 @@ def main():
     ap.add_argument("--config", default="fedavg", choices=list(CONFIGS))
     ap.add_argument("--lr", type=float, default=0.4)
     ap.add_argument("--epochs", type=int, default=24)
-    ap.add_argument("--task", default="cifar_v3")
+    ap.add_argument("--task", default="cifar_v3", choices=list(TASKS))
     args = ap.parse_args()
 
     if args.cmd == "one":
-        fn = run_cifar if args.task == "cifar_v3" else run_imagenet
-        fn(args.config, args.lr, epochs=args.epochs)
+        run(args.task, args.config, args.lr, epochs=args.epochs)
         return
     if args.cmd == "grid":
         # full triad at a small per-config grid around the tuned dense
-        # optimum (0.8 at B=64; iso_steps at B=16 sees 4x the rounds so its
-        # per-round lr wants to sit lower)
+        # optimum (0.8 at B=64; the B=16 legs see 4x the rounds / smaller
+        # batches so their per-round lr wants to sit lower)
         for config, lrs in [
             ("iso_bytes", (0.4, 0.8, 1.6)),
             ("iso_steps", (0.2, 0.4, 0.8)),
             ("fedavg", (0.2, 0.4, 0.8)),
         ]:
             for lr in lrs:
-                run_cifar(config, lr, epochs=args.epochs)
+                run("cifar_v3", config, lr, epochs=args.epochs)
     else:
         # tuned ImageNet redo: short-budget grid, then report the 12-ep
         # triad at each config's best short-budget lr (run manually via
         # `one` after reading the grid)
         for config in ("iso_bytes", "fedavg"):
             for lr in (0.1, 0.2, 0.4):
-                run_imagenet(config, lr, epochs=4)
+                run("imagenet", config, lr, epochs=4)
 
 
 if __name__ == "__main__":

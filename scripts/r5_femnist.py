@@ -18,15 +18,16 @@ to 1.0000. This redo applies the repo's own methodology:
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-ROOT = Path(__file__).resolve().parent.parent
-LOG = ROOT / "runs" / "r5_femnist.log"
+from labutil import log_json
+
+LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_femnist.log"
 
 MODES = {
     "local_topk": ["--mode", "local_topk", "--error_type", "local",
@@ -52,10 +53,7 @@ def run_one(mode: str, lr: float, *, epochs=20, seed=42):
     rec = {"mode": mode, "lr": lr, "epochs": epochs,
            "acc": round(float(val.get("accuracy", float("nan"))), 4),
            "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
-    print("==", json.dumps(rec), flush=True)
-    LOG.parent.mkdir(exist_ok=True)
-    with LOG.open("a") as f:
-        f.write(json.dumps(rec) + "\n")
+    log_json(LOG, rec)
     return rec
 
 

@@ -23,15 +23,16 @@ moves gets an lr confirmation at 0.4/1.2 (`one --lr`).
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-ROOT = Path(__file__).resolve().parent.parent
-LOG = ROOT / "runs" / "r5_residual.log"
+from labutil import log_json
+
+LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_residual.log"
 
 VARIANTS = {
     "base": (dict(), True),
@@ -87,10 +88,7 @@ def run_one(name: str, gen_kw: dict, use_augment: bool, *, lr=0.8, pivot=6,
            "augment": use_augment, "gen": gen_kw,
            "acc": round(float(val.get("accuracy", float("nan"))), 4),
            "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
-    print("==", json.dumps(rec), flush=True)
-    LOG.parent.mkdir(exist_ok=True)
-    with LOG.open("a") as f:
-        f.write(json.dumps(rec) + "\n")
+    log_json(LOG, rec)
     return rec
 
 

@@ -151,6 +151,63 @@ def test_envelope_warning_suggestion_converges():
     assert not build(suggest), "following the suggestion must clear the check"
 
 
+def test_envelope_predictor_matches_measured_cliffs():
+    """The fitted error-bank model (parallel/envelope.py) must reproduce
+    the r4 sweep's three measured cliff locations (runs/r4_envelope.log)
+    and stay monotone in gamma — the r5 replacement for the hard-coded
+    d > 25*c check (VERDICT r4 item 6)."""
+    from commefficient_tpu.parallel.envelope import (
+        predicted_dc_max,
+        stable_dc_bound,
+    )
+
+    # gamma=1: cliff measured between 25 (trains) and 30 (chance)
+    assert 25 < predicted_dc_max(1.0) < 30
+    # gamma=0.95: 35 partial / 40 broken
+    assert 33 < predicted_dc_max(0.95) < 40
+    # gamma=0.9: 40 trains fully / 50 partial
+    assert 40 < predicted_dc_max(0.9) < 50
+    # lower decay -> strictly wider envelope
+    gammas = [1.0, 0.95, 0.9, 0.85, 0.8]
+    preds = [predicted_dc_max(g) for g in gammas]
+    assert preds == sorted(preds) and len(set(preds)) == len(preds)
+    # the runtime bound is conservative: below the fitted cliff everywhere
+    for g in gammas:
+        assert stable_dc_bound(g) < predicted_dc_max(g)
+
+
+def test_envelope_warning_gamma_dependent():
+    """error_decay widens the runtime envelope: a d/c that warns undecayed
+    must pass the check at gamma=0.9 (fitted bound ~41.7 vs 23.1)."""
+    import warnings as _w
+
+    import flax.linen as nn
+
+    class Wide(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.Dense(8192)(x))
+
+    m = Wide()
+    params = m.init(jax.random.key(0), jnp.zeros((1, 256)))
+    loss_fn = classification_loss(m.apply)
+    d = ravel_params(params)[0].size
+    kw = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+              k=16, num_rows=3, **{**BASE, "num_devices": 1})
+
+    def build(error_decay):
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            FederatedSession(
+                Config(num_cols=int(d / 30), error_decay=error_decay, **kw),
+                params, loss_fn,
+            )
+            return [str(x.message) for x in rec if "envelope" in str(x.message)]
+
+    assert build(1.0), "d/c ~30 undecayed must warn (cliff ~27)"
+    assert not build(0.9), "d/c ~30 at gamma=0.9 is inside the fitted bound"
+
+
 def test_error_decay_zero_matches_no_error_sketch():
     """error_decay (the r4 d/c-envelope mitigation knob) at gamma=0 drops
     the whole carried error each round, which must reduce the virtual-error
