@@ -314,6 +314,8 @@ def main():
         rows["sketch_fused_headline"] = round(headline, 2)
         rows["mfu_model_flops"] = round(mfu, 4)
         rows["chip"] = chip
+        if assumed:  # same in-band marker as the headline line
+            rows["peak_flops_assumed"] = peak
         rows.update(gpt2)
         with open("BENCH_MATRIX.json", "w") as f:
             json.dump(rows, f, indent=2)

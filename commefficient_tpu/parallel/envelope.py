@@ -58,8 +58,18 @@ def predicted_dc_max(error_decay: float, *, rho1: float = RHO1,
     return rho1 * ((1.0 - g * (1.0 - phi)) / phi) ** 2
 
 
+# The gamma range the model was fitted/validated on. Below it the formula
+# extrapolates; the runtime bound refuses to follow it there (review r5:
+# error_decay=0.5 would otherwise predict d/c ~147 and silently disable
+# the guardrail the old hard-coded check always gave).
+GAMMA_FIT_MIN = 0.85
+
+
 def stable_dc_bound(error_decay: float) -> float:
     """The conservative bound the runtime warning enforces: the fitted
-    cliff scaled back to the last measured-fully-stable point
-    (25/27 at gamma=1)."""
-    return SAFETY * predicted_dc_max(error_decay)
+    cliff scaled back to the last measured-fully-stable point (25/27 at
+    gamma=1), with gamma CLAMPED to the measured range — an error_decay
+    below GAMMA_FIT_MIN gets GAMMA_FIT_MIN's bound, not the formula's
+    unvalidated extrapolation."""
+    g = max(float(error_decay), GAMMA_FIT_MIN)
+    return SAFETY * predicted_dc_max(g)

@@ -39,9 +39,9 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from labutil import log_json
+from labutil import ROOT, log_json
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_fedavg.log"
+LOG = ROOT / "runs" / "r5_fedavg.log"
 
 # (mode flags, local_batch_size) per triad leg — see module docstring for
 # the samples/round accounting behind each batch size

@@ -30,9 +30,9 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from labutil import log_json
+from labutil import ROOT, log_json
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_fsdp_gpt2.log"
+LOG = ROOT / "runs" / "r5_fsdp_gpt2.log"
 
 
 def _log(rec):

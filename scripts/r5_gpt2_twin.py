@@ -29,7 +29,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import r4_gpt2_twin as twin
 
-twin.LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_gpt2_twin.log"
+from labutil import ROOT
+
+twin.LOG = ROOT / "runs" / "r5_gpt2_twin.log"
 
 
 def main():

@@ -10,6 +10,7 @@ gather+augment mirrors the numpy/native pixel ops.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from commefficient_tpu.data import FedSampler, augment_batch, prefetch
 from commefficient_tpu.data.cifar import CifarAugment, device_augment
@@ -185,6 +186,10 @@ def test_index_path_multidevice():
     assert np.isfinite(float(np.asarray(m["loss"])))
 
 
+@pytest.mark.slow  # r5 tier budget: the e2e EXERCISE of the device-data
+# path stays default-tier via test_train_entry's femnist e2e (device_data
+# defaults true there too) and the index==batch parity tests above; this
+# 70s test only adds the spy ASSERTION that the path was taken
 def test_cv_train_takes_device_data_path_e2e(tmp_path):
     """cv_train end-to-end (femnist: small, augment-free) must take the
     device-data path by default and produce finite metrics."""

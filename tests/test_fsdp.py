@@ -153,3 +153,53 @@ def test_fsdp_rejects_local_modes():
                    **BASE),
             params, loss_fn,
         )
+
+
+def test_fsdp_composes_with_tp_sp_axes():
+    """FSDP x model/seq composition (VERDICT r4 missing 3): the FSDP
+    round's P(workers) state specs replicate over the model/seq axes, and
+    build_tp_flat_loss's MODEL/SEQ collectives run inside the same
+    shard_map — so a dp2 x tp2 x sp2 mesh with fsdp=True must match the
+    replicated round on the identical mesh bit-for-bit."""
+    from commefficient_tpu.models import gpt2_double_heads_loss
+    from commefficient_tpu.models.gpt2 import GPT2Config, GPT2DoubleHeads
+    from commefficient_tpu.parallel import make_mesh, mask_gpt2
+    from commefficient_tpu.parallel.tensor import build_tp_flat_loss
+
+    rng = np.random.default_rng(0)
+    wk, tp_sz, sq = 2, 2, 2
+    mesh3 = make_mesh(wk, tp_sz, sq)
+    T = 16 * sq
+    gcfg = GPT2Config(vocab_size=256, n_positions=T, n_embd=32, n_layer=2,
+                      n_head=4, dtype=jnp.float32)
+    gmodel = GPT2DoubleHeads(gcfg)
+    B, N = 2, 2
+    ids = rng.integers(0, 256, size=(wk, B, N, T)).astype(np.int32)
+    gparams = gmodel.init(jax.random.key(0), jnp.asarray(ids[0]),
+                          token_type_ids=jnp.asarray(ids[0]),
+                          mc_token_ids=jnp.zeros((B, N), jnp.int32))
+    lm = ids.copy()
+    lm[..., : T // 2] = -100
+    batch = {"input_ids": ids, "token_type_ids": ids, "lm_labels": lm,
+             "mc_token_ids": rng.integers(0, T, size=(wk, B, N)).astype(np.int32),
+             "mc_labels": rng.integers(0, N, size=(wk, B)).astype(np.int32)}
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=64, num_rows=3, num_cols=2048,
+                 num_clients=2 * wk, num_workers=wk, num_devices=wk,
+                 model_axis=tp_sz, seq_axis=sq, local_batch_size=B,
+                 weight_decay=0.0, device_data=False, fsdp=True,
+                 topk_method="threshold")
+    cids = np.arange(wk, dtype=np.int32)
+    finals = []
+    for fsdp in (True, False):
+        sess = FederatedSession(
+            cfg.replace(fsdp=fsdp), gparams,
+            build_tp_flat_loss(gcfg, mesh3), mesh=mesh3,
+            mask_batch=mask_gpt2,
+            eval_loss_fn=gpt2_double_heads_loss(gmodel.apply),
+        )
+        for r in range(2):
+            m = sess.train_round(cids, batch, lr=0.05)
+        assert np.isfinite(float(np.asarray(m["loss"])))
+        finals.append(np.asarray(sess.state.params_vec)[: sess.grad_size])
+    np.testing.assert_array_equal(finals[0], finals[1])

@@ -59,6 +59,9 @@ def test_resnet9_flat_vector_roundtrip():
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b), rtol=1e-6)
 
 
+@pytest.mark.slow  # r5 tier budget: structural init check (~40s of
+# compile); the model is exercised at full scale by every ImageNet
+# evidence run and the imagenet-augment equivalence tests stay default
 def test_fixup_resnet50_forward():
     model = fixup_resnet50(num_classes=10)
     x = jnp.zeros((2, 64, 64, 3))  # small spatial size still exercises all stages
