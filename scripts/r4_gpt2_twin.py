@@ -26,7 +26,8 @@ LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_gpt2_twin.log"
 
 
 def run_one(mode: str, lr: float, *, epochs=6, pivot=2, seq=256, batch=4,
-            workers=8, clients=32, rows=5, cols=5_000_000, k=50_000):
+            workers=8, clients=32, rows=5, cols=5_000_000, k=50_000,
+            extra_argv=()):
     from commefficient_tpu.train import gpt2_train
 
     argv = [
@@ -44,6 +45,7 @@ def run_one(mode: str, lr: float, *, epochs=6, pivot=2, seq=256, batch=4,
                  "--num_cols", str(cols), "--fuse_clients", "true"]
     else:
         argv += ["--fuse_clients", "true"]
+    argv += list(extra_argv)
     t0 = time.time()
     val = gpt2_train.main(argv)
     dt = time.time() - t0
@@ -52,6 +54,10 @@ def run_one(mode: str, lr: float, *, epochs=6, pivot=2, seq=256, batch=4,
            "ppl": round(float(val["ppl"]), 1),
            "mc_acc": round(float(val["mc_accuracy"]), 4),
            "seconds": round(dt)}
+    if mode == "sketch" and (rows, cols) != (5, 5_000_000):
+        rec["table"] = f"{rows}x{cols}"
+    if extra_argv:
+        rec["extra"] = list(extra_argv)
     print("==", json.dumps(rec), flush=True)
     LOG.parent.mkdir(exist_ok=True)
     with LOG.open("a") as f:

@@ -36,12 +36,26 @@ twin.LOG = ROOT / "runs" / "r5_gpt2_twin.log"
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("cmd", choices=["extend", "deep", "one"])
+    ap.add_argument("cmd", choices=["extend", "deep", "one", "variants"])
     ap.add_argument("--mode", default="sketch")
     ap.add_argument("--lr", type=float, default=0.32)
     ap.add_argument("--epochs", type=int, default=6)
     ap.add_argument("--pivot", type=int, default=None)
     args = ap.parse_args()
+
+    if args.cmd == "variants":
+        # same-bytes sketch variants probing the 0.16-nat 24-ep gap:
+        # (a) gamma=0.95 — d/c 24.9 sits at the undecayed cliff's edge;
+        #     mild decay cheaply buys error-bank SNR headroom
+        # (b) r=7 x 3.57M (same 25M-float table) — the CV result says the
+        #     stronger median beats per-row width; d/c/row 34.9 needs
+        #     gamma=0.9 (fitted envelope: rho*(0.9) ~ 45)
+        twin.run_one("sketch", 0.08, epochs=24, pivot=4,
+                     extra_argv=("--error_decay", "0.95"))
+        twin.run_one("sketch", 0.08, epochs=24, pivot=4,
+                     rows=7, cols=3_571_428,
+                     extra_argv=("--error_decay", "0.9"))
+        return
 
     if args.cmd == "extend":
         # past-the-edge points for the uncompressed 6-ep grid
