@@ -1,0 +1,71 @@
+"""r5: where does r=7x357k's disproportionate cost go? (VERDICT item 5)
+
+Full-run arithmetic says r=5x500k pays +59 s over uncompressed on the
+24-ep CV run while r=7x357k pays +165 s — 2.8x, where row-linear would be
+1.4x. Suspect: the GEOMETRY. The adaptive chunk rule grows m until each
+chunk owns >= 256 buckets; at c=357k that regime differs from c=500k
+(bigger m -> wider [nc, m] x [m, V] einsums per row and a different
+scramble-block realization).
+
+This probe prints the realized geometry and scan-timed sketch_vec /
+estimate_all for the two production specs plus r=7 variants with pinned
+m and band, so the fix (if any) is a measured geometry pin, not a guess.
+
+    python scripts/r5_r7probe.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from profile_scan import scan_time  # the carry-chained lax.scan harness
+
+from labutil import ROOT, log_json
+
+LOG = ROOT / "runs" / "r5_r7probe.log"
+
+
+def probe(name, spec, v, n=20):
+    from commefficient_tpu.ops.countsketch import estimate_all, sketch_vec
+
+    table = jax.jit(lambda x: sketch_vec(spec, x))(v)
+    geo = dict(r=spec.r, c=spec.c, c_actual=spec.c_actual, m=spec.chunk_m,
+               sblock=spec.sblock, band=spec.band,
+               s=spec.s, d_eff=spec.d_eff)
+    t_sk = scan_time(f"{name} sketch_vec",
+                     lambda s: jnp.sum(sketch_vec(spec, v + s)), n)
+    t_es = scan_time(f"{name} estimate_all",
+                     lambda s: jnp.sum(estimate_all(spec, table + s)), n)
+    log_json(LOG, {"name": name, **geo,
+                   "sketch_ms": round(t_sk, 2), "estimate_ms": round(t_es, 2)})
+
+
+def main():
+    print(f"devices: {jax.devices()}")
+    d = 6_598_654  # ResNet-9 CV grad size (the accuracy-table model)
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    from commefficient_tpu.ops.countsketch import CountSketch
+
+    scan_time("empty scan (overhead floor)", lambda s: s)
+    probe("r5x500k_default", CountSketch(d=d, c=500_000, r=5, seed=42), v)
+    probe("r7x357k_default", CountSketch(d=d, c=357_143, r=7, seed=42), v)
+    for m in (2048, 4096, 8192):
+        probe(f"r7x357k_m{m}",
+              CountSketch(d=d, c=357_143, r=7, seed=42, m=m), v)
+    probe("r7x357k_band8",
+          CountSketch(d=d, c=357_143, r=7, seed=42, band=8), v)
+    probe("r5x500k_band8",
+          CountSketch(d=d, c=500_000, r=5, seed=42, band=8), v)
+
+
+if __name__ == "__main__":
+    main()

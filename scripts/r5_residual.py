@@ -43,8 +43,18 @@ VARIANTS = {
 }
 
 
+MODE_KW = {
+    # tuned schedules from the r4/r5 accuracy table (lr overridable)
+    "uncompressed": dict(mode="uncompressed", fuse_clients=True),
+    "sketch7": dict(mode="sketch", error_type="virtual",
+                    virtual_momentum=0.9, k=50_000, num_rows=7,
+                    num_cols=357_143, fuse_clients=True),
+    "local_topk": dict(mode="local_topk", error_type="local", k=50_000),
+}
+
+
 def run_one(name: str, gen_kw: dict, use_augment: bool, *, lr=0.8, pivot=6,
-            epochs=24, seed=42):
+            epochs=24, seed=42, mode="uncompressed"):
     import jax
     import jax.numpy as jnp
 
@@ -67,8 +77,7 @@ def run_one(name: str, gen_kw: dict, use_augment: bool, *, lr=0.8, pivot=6,
         dataset_name="cifar10", model="resnet9", num_epochs=epochs,
         num_clients=16, num_workers=8, num_devices=1, local_batch_size=64,
         weight_decay=5e-4, seed=seed, topk_method="threshold",
-        lr_scale=lr, pivot_epoch=pivot, mode="uncompressed",
-        fuse_clients=True,
+        lr_scale=lr, pivot_epoch=pivot, **MODE_KW[mode],
     )
     train_d, test_d = _synthetic_cifar_concentrated(10, **gen_kw)
     train = FedDataset(dict(train_d), cfg.num_clients, iid=True, seed=cfg.seed)
@@ -84,7 +93,7 @@ def run_one(name: str, gen_kw: dict, use_augment: bool, *, lr=0.8, pivot=6,
     t0 = time.time()
     val = train_loop(cfg, session, sampler, test, table=TableLogger())
     dt = time.time() - t0
-    rec = {"name": name, "lr": lr, "epochs": epochs,
+    rec = {"name": name, "mode": mode, "lr": lr, "epochs": epochs,
            "augment": use_augment, "gen": gen_kw,
            "acc": round(float(val.get("accuracy", float("nan"))), 4),
            "loss": round(float(val["loss"]), 4), "seconds": round(dt)}
@@ -94,15 +103,28 @@ def run_one(name: str, gen_kw: dict, use_augment: bool, *, lr=0.8, pivot=6,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("cmd", choices=["grid", "one"])
+    ap.add_argument("cmd", choices=["grid", "one", "noaug"])
     ap.add_argument("--name", default="base")
+    ap.add_argument("--mode", default="uncompressed", choices=list(MODE_KW))
     ap.add_argument("--lr", type=float, default=0.8)
+    ap.add_argument("--pivot", type=int, default=6)
     ap.add_argument("--epochs", type=int, default=24)
     args = ap.parse_args()
 
     if args.cmd == "one":
         gen_kw, use_aug = VARIANTS[args.name]
-        run_one(args.name, gen_kw, use_aug, lr=args.lr, epochs=args.epochs)
+        run_one(args.name, gen_kw, use_aug, lr=args.lr, pivot=args.pivot,
+                epochs=args.epochs, mode=args.mode)
+        return
+    if args.cmd == "noaug":
+        # the verdict's re-run criterion fired (no_augment recovered >2
+        # pts): the north-star modes, no-augment pipeline, tuned
+        # schedules (dense lr bracketed since its optimum may shift)
+        run_one("no_augment", dict(), False, lr=0.6, mode="uncompressed")
+        run_one("no_augment", dict(), False, lr=1.0, mode="uncompressed")
+        run_one("no_augment", dict(), False, lr=0.1, pivot=2, mode="sketch7")
+        run_one("no_augment", dict(), False, lr=0.15, pivot=2, mode="sketch7")
+        run_one("no_augment", dict(), False, lr=0.8, mode="local_topk")
         return
     for name, (gen_kw, use_aug) in VARIANTS.items():
         run_one(name, gen_kw, use_aug, epochs=args.epochs)
