@@ -52,6 +52,15 @@ sharding that transient over model/seq too would need a
 TP-native-parameter round (tensor.build_tp3d_train_step territory), which
 matters only when D itself outgrows a chip — not at the D=124M scales
 reachable here (0.5 GB f32 transient vs 16 GB HBM).
+
+Wall-clock note (r5, measured): sketch-mode FSDP extraction estimates
+each chip's D/W coordinate range via the ``estimate_at`` GATHER path
+(offset-indexed global hashes), where the replicated round uses the
+``estimate_all`` matmul path over the full vector. On a degenerate
+1-chip mesh (W axis = 1) that is a full-D gather per round and costs
+~6x the replicated round at D=124M (runs/r5_fsdp_gpt2.log part=chip,
+nll parity) — use FSDP only when the workers axis is real, which is
+also the only time its memory win exists.
 """
 
 from __future__ import annotations

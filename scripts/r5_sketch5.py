@@ -33,15 +33,30 @@ BASE = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
             num_rows=5, num_cols=500_000, fuse_clients=True)
 
 
+R7 = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+          k=50_000, num_rows=7, num_cols=357_143, fuse_clients=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("cmd", choices=["grid", "one"])
+    ap.add_argument("cmd", choices=["grid", "one", "geom"])
     ap.add_argument("--lr", type=float, default=0.04)
     ap.add_argument("--pivot", type=int, default=2)
     ap.add_argument("--k", type=int, default=50_000)
     ap.add_argument("--epochs", type=int, default=24)
     args = ap.parse_args()
 
+    if args.cmd == "geom":
+        # r7x357k with the chunk size PINNED below the adaptive >=256-
+        # bucket floor (r5_r7probe: the floor forces m=8192/s=432 and a
+        # 1.42x per-row window; m=4096 -> -18% op cost, m=2048 -> -48%).
+        # Does r=7's stronger median tolerate the smaller pools the r3
+        # postmortem ruled out at r=3/5? Accuracy + wall-clock decide.
+        retune.run_one("sketch7_m4096", dict(R7, sketch_m=4096), 0.1, 2,
+                       epochs=args.epochs)
+        retune.run_one("sketch7_m2048", dict(R7, sketch_m=2048), 0.1, 2,
+                       epochs=args.epochs)
+        return
     if args.cmd == "one":
         retune.run_one(f"sketch5_k{args.k//1000}k", dict(BASE, k=args.k),
                        args.lr, args.pivot, epochs=args.epochs)
