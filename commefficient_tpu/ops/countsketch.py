@@ -329,6 +329,15 @@ class CountSketch(NamedTuple):
         """Chunk size. Adaptive default: grow m (512..32768, powers of 2)
         until each chunk gets >= 256 buckets.
 
+        Measured alternative when the floor binds (r5, runs/r5_sketch5.log
+        + r5_r7probe.log): at r=7 x c=357k the floor forces m=8192/s=432
+        and a 1.42x-wide einsum window per row; pinning ``m=4096``
+        (s=224, just under the floor) with ``band=24`` (restores the
+        overlap-add collision pool to V ~ 5184) trains to 0.9004 vs the
+        default geometry's 0.8997 at 25% less wall-clock. Do NOT go
+        further down: m=2048 (s=112) diverges — the floor is a real
+        stability boundary, band is the safe recovery lever.
+
         The bucket-pool target is STABILITY-critical, not a tuning nicety:
         with small pools the per-chunk victim sets are so small that
         FetchSGD's extract-and-subtract feedback loop amplifies collision

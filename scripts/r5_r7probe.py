@@ -49,12 +49,34 @@ def probe(name, spec, v, n=20):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gpt2", action="store_true",
+                    help="probe the GPT-2-scale 5x5M geometry instead "
+                    "(the floor binds there too: c=5M forces m=8192)")
+    args = ap.parse_args()
+
     print(f"devices: {jax.devices()}")
+    from commefficient_tpu.ops.countsketch import CountSketch
+
+    if args.gpt2:
+        d = 124_444_417  # GPT-2-small twin grad size
+        rng = np.random.default_rng(0)
+        v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+        scan_time("empty scan (overhead floor)", lambda s: s, 8)
+        probe("g_r5x5M_default", CountSketch(d=d, c=5_000_000, r=5, seed=42),
+              v, 8)
+        probe("g_r5x5M_m4096",
+              CountSketch(d=d, c=5_000_000, r=5, seed=42, m=4096), v, 8)
+        probe("g_r5x5M_m4096_band24",
+              CountSketch(d=d, c=5_000_000, r=5, seed=42, m=4096, band=24),
+              v, 8)
+        return
+
     d = 6_598_654  # ResNet-9 CV grad size (the accuracy-table model)
     rng = np.random.default_rng(0)
     v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
-    from commefficient_tpu.ops.countsketch import CountSketch
-
     scan_time("empty scan (overhead floor)", lambda s: s)
     probe("r5x500k_default", CountSketch(d=d, c=500_000, r=5, seed=42), v)
     probe("r7x357k_default", CountSketch(d=d, c=357_143, r=7, seed=42), v)
