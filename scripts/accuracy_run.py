@@ -69,6 +69,10 @@ def main():
         "uncompressed_mom": (0.06, piv),
         "sketch_rho09": (0.04, 2),
         "sketch_rho09_r7": (0.1, 2),
+        # r5 fast geometry: chunk m pinned under the adaptive floor +
+        # band=24 pool restore — 0.9004 at 1.69x uncompressed wall-clock
+        # (runs/r5_sketch5.log; grid 0.06/0.1/0.15 interior at 0.1)
+        "sketch_rho09_r7_fast": (0.1, 2),
         "sketch_rho0": (0.8, piv),
         # AUTO dampening now resolves False for true_topk (r4 four-corner
         # ablation) — tuned lr for the unmasked corner
@@ -94,6 +98,10 @@ def main():
             "sketch_rho09_r7", mode="sketch", error_type="virtual",
             virtual_momentum=0.9, k=k, num_rows=7, num_cols=357_143,
             fuse_clients=True)),
+        ("sketch (7x357k, m=4096, band=24 — r5 fast geometry)", mk(
+            "sketch_rho09_r7_fast", mode="sketch", error_type="virtual",
+            virtual_momentum=0.9, k=k, num_rows=7, num_cols=357_143,
+            sketch_m=4096, sketch_band=24, fuse_clients=True)),
         ("sketch (FetchSGD, rho=0)", mk(
             "sketch_rho0", mode="sketch", error_type="virtual",
             virtual_momentum=0.0, k=k, num_rows=5, num_cols=500_000,
