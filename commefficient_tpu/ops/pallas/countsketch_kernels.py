@@ -1,0 +1,358 @@
+"""Pallas TPU kernels for the CountSketch hot path (sketch_backend='pallas').
+
+The banded-einsum path (ops/countsketch.py) realizes each row as
+
+    [nc, m] signed values  x  [m, V] STATIC one-hot  ->  [nc, V]  ->  overlap-add
+
+which is MXU-friendly but pays for it three ways at GPT-2 scale
+(d=124M, c=5M, m=8192, V~5k — the BENCH_r05 3.5x sketch-round gap):
+
+  1. the [m, V] one-hot is a materialized jit constant (~170 MB f32 at the
+     GPT-2 geometry) that streams from HBM on every row;
+  2. the [nc, V] window intermediate (~320 MB) round-trips HBM between the
+     einsum and the overlap-add;
+  3. the sign vector is a materialized [d_eff] table — and for the poly4
+     hash family it is HOST-evaluated uint64 numpy, which is why poly4 was
+     CV-scale-only before this module.
+
+Here each row is ONE tiled kernel: a grid over chunk tiles keeps a
+[TC, V] accumulator in VMEM, loops over offset tiles generating the
+[MT, V] one-hot ON THE FLY from the hash (fmix32 or poly4), computes the
+per-element sign from the inverse-riffled scrambled position (32-bit
+integer arithmetic only — nothing [d_eff]-sized ever exists), and fuses
+the band overlap-add before writing its (TC+u-1)*s output tile. The
+estimate direction runs the transposed contraction with the same on-the-fly
+hashes, and a small compare-exchange kernel takes the median across rows —
+the full unsketch front end before top-k selection.
+
+poly4 without uint64: TPUs have no 64-bit integers, so the degree-3
+Mersenne-31 polynomial is evaluated with a 16-bit-limb modular multiply
+(``_modmul31``/``_poly4_u32``, defined next to the hash family in
+ops/countsketch.py): exact for all operands < p = 2^31 - 1, bit-identical
+to the host uint64 evaluation (pinned by tests/test_countsketch_pallas.py).
+This is what unlocks the 4-universal guarantee class at D=124M.
+
+Numerics: tiles accumulate in f32 via ``preferred_element_type`` exactly
+like the einsum path; only the float SUMMATION ORDER differs, so the two
+backends agree to fp32 rounding (not bit-exactly). Layout permutations
+(scramble, riffle) stay outside the kernels — they are cheap gathers /
+transposes and keeping them shared guarantees the two backends use one
+geometry.
+
+On CPU (tier-1 tests) every kernel runs under Pallas interpret mode; on a
+TPU backend the same calls compile through Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from commefficient_tpu.ops.countsketch import (
+    _GOLDEN,
+    _MERSENNE_P,
+    _ceil_mult,
+    _from_layout,
+    _mix32,
+    _poly4_u32,
+    _scramble,
+    _to_layout,
+    _unscramble,
+)
+
+
+def _interpret() -> bool:
+    """Interpret Pallas kernels everywhere but a real TPU backend (the
+    tier-1 suite runs JAX_PLATFORMS=cpu; the kernels must stay testable
+    there). Evaluated at trace time — static per compilation."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# per-row static geometry + in-kernel hash helpers
+# ---------------------------------------------------------------------------
+
+
+@_functools.lru_cache(maxsize=None)
+def _row_geom(spec, row: int):
+    """Static tile plan for one row. Returns a dict of python ints.
+
+    MT: offset-tile width (lane-dim of the generated one-hot — MT*V*4 B of
+    VMEM). TC: chunk-tile height, sized so the [TC, m_pad] input block
+    stays ~2 MB, floored at the band width u so the body/tail
+    recombination below stays a single shifted add."""
+    m = spec.chunk_m
+    u, s = spec.u_row(row), spec.s_row(row)
+    MT = min(256, _ceil_mult(m, 8))
+    m_pad = _ceil_mult(m, MT)
+    TC = max(8, min(64, (2 << 20) // (m_pad * 4) // 8 * 8))
+    TC = max(TC, u)
+    nc = spec._nc_row(row)
+    nc_pad = _ceil_mult(nc, TC)
+    return dict(
+        m=m, m_pad=m_pad, MT=MT, TC=TC, nc=nc, nc_pad=nc_pad,
+        nt=nc_pad // TC, u=u, s=s, V=u * s, TB=(TC + u - 1) * s,
+        f=spec._factor(row), L=spec._L_row(row),
+    )
+
+
+def _row_hashes(spec, row: int):
+    """(slot_fn, sign_fn) for this row — pure uint32 jnp, safe inside a
+    Pallas kernel body. slot_fn: offset array -> int32 in-window bucket.
+    sign_fn: riffled layout position -> +-1 f32 (maps the position back to
+    its scrambled-space index first, so it agrees with the einsum path's
+    pre-layout ``v_s * _row_signs``)."""
+    g = _row_geom(spec, row)
+    f, G, V = g["f"], g["L"] // g["f"], g["V"]
+    if spec.hash_family == "poly4":
+        c_slot = tuple(int(c) for c in spec._poly4_coeffs(row, 0))
+        c_sign = tuple(int(c) for c in spec._poly4_coeffs(row, 1))
+
+        def slot_fn(off):
+            return (_poly4_u32(off, c_slot) % jnp.uint32(V)).astype(jnp.int32)
+
+        def sign_bits(spos):
+            return _poly4_u32(spos, c_sign) & jnp.uint32(1)
+    else:
+        key = spec._row_key(row)
+
+        def slot_fn(off):
+            return (_mix32(off, key) % jnp.uint32(V)).astype(jnp.int32)
+
+        def sign_bits(spos):
+            return _mix32(spos, key ^ _GOLDEN) & jnp.uint32(1)
+
+    def sign_fn(pos):
+        if f > 1:
+            spos = (pos % jnp.uint32(f)) * jnp.uint32(G) + pos // jnp.uint32(f)
+        else:
+            spos = pos
+        return 1.0 - 2.0 * sign_bits(spos).astype(jnp.float32)
+
+    return slot_fn, sign_fn
+
+
+def _check_poly4_field(spec) -> None:
+    """The in-kernel Mersenne arithmetic (and 4-universality itself) needs
+    every hashed input < p — same contract the host ``_poly4_eval``
+    enforces with its ValueError, checked here statically against the
+    largest padded layout position."""
+    if spec.hash_family != "poly4":
+        return
+    worst = max(spec._L_row(r) for r in range(spec.r))
+    if worst >= int(_MERSENNE_P):
+        raise ValueError(
+            f"poly4 layout position bound {worst} >= p=2^31-1; the "
+            "4-universal family is only defined over GF(p) — use "
+            "hash_family='fmix32' at this scale"
+        )
+
+
+def _sign_tile(sign_fn, base, m, TC, MT, j):
+    """[TC, MT] signs for chunk rows base..base+TC, offset cols j*MT..+MT."""
+    q = jax.lax.broadcasted_iota(jnp.uint32, (TC, MT), 0) + jnp.uint32(base)
+    o = jax.lax.broadcasted_iota(jnp.uint32, (TC, MT), 1) + (
+        jnp.uint32(MT) * j.astype(jnp.uint32)
+    )
+    return sign_fn(q * jnp.uint32(m) + o)
+
+
+# ---------------------------------------------------------------------------
+# sketch-accumulate kernel (one row)
+# ---------------------------------------------------------------------------
+
+
+def _sketch_row(spec, v_s: jnp.ndarray, row: int) -> jnp.ndarray:
+    """One row of the table from the scrambled [d_eff] vector: tiled
+    hash + sign + one-hot contraction + fused overlap-add."""
+    g = _row_geom(spec, row)
+    TC, MT, m, m_pad = g["TC"], g["MT"], g["m"], g["m_pad"]
+    u, s, V, TB, nt = g["u"], g["s"], g["V"], g["TB"], g["nt"]
+    slot_fn, sign_fn = _row_hashes(spec, row)
+    nj = m_pad // MT
+
+    sv = _to_layout(spec, v_s, row)  # [nc, m], unsigned (signs in-kernel)
+    sv = jnp.pad(sv, ((0, g["nc_pad"] - g["nc"]), (0, m_pad - m)))
+
+    def kernel(sv_ref, out_ref):
+        base = pl.program_id(0) * TC
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (MT, V), 1)
+
+        def body(j, acc):
+            o = jax.lax.broadcasted_iota(jnp.uint32, (MT, 1), 0) + (
+                jnp.uint32(MT) * j.astype(jnp.uint32)
+            )
+            onehot = (slot_fn(o) == col_ids).astype(spec.dtype)
+            vals = sv_ref[:, pl.ds(j * MT, MT)]
+            signed = (vals * _sign_tile(sign_fn, base, m, TC, MT, j)).astype(
+                spec.dtype
+            )
+            return acc + jax.lax.dot_general(
+                signed,
+                onehot,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        acc = jax.lax.fori_loop(0, nj, body, jnp.zeros((TC, V), jnp.float32))
+        # fused band overlap-add: [TC, u, s] windows -> [(TC+u-1), s], each
+        # shift realized as a tiny static one-hot matmul (iota-generated —
+        # no pad/concat primitives inside the kernel)
+        if u == 1:
+            out_ref[0, :] = acc.reshape(TB)
+            return
+        a3 = acc.reshape(TC, u, s)
+        rows_out = jax.lax.broadcasted_iota(jnp.int32, (TC + u - 1, TC), 0)
+        rows_in = jax.lax.broadcasted_iota(jnp.int32, (TC + u - 1, TC), 1)
+        out2d = jnp.zeros((TC + u - 1, s), jnp.float32)
+        for sh in range(u):
+            shift = (rows_out == rows_in + sh).astype(jnp.float32)
+            out2d = out2d + jax.lax.dot_general(
+                shift,
+                a3[:, sh, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        out_ref[0, :] = out2d.reshape(TB)
+
+    tiles = pl.pallas_call(
+        kernel,
+        grid=(nt,),
+        in_specs=[pl.BlockSpec((TC, m_pad), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, TB), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nt, TB), jnp.float32),
+        interpret=_interpret(),
+    )(sv)
+
+    # recombine: tile i covers row positions [i*TC*s, i*TC*s + TB); only the
+    # (u-1)*s tail overlaps the next tile's body (TC >= u by construction),
+    # so the whole stitch is ONE shifted add + concat.
+    bodies = tiles[:, : TC * s]
+    if u > 1:
+        tails = tiles[:, TC * s:]
+        bodies = bodies.at[1:, : (u - 1) * s].add(tails[:-1])
+        flat = jnp.concatenate([bodies.reshape(-1), tails[-1]])
+    else:
+        flat = bodies.reshape(-1)
+    n = min(flat.shape[0], spec.c_actual)
+    return jnp.pad(flat[:n], (0, spec.c_actual - n))
+
+
+def sketch_vec_pallas(spec, v: jnp.ndarray) -> jnp.ndarray:
+    """Pallas backend of ``sketch_vec`` — same table, kernel-tiled."""
+    _check_poly4_field(spec)
+    v_s = _scramble(spec, v.astype(jnp.float32))  # ONE block-gather, all rows
+    return jnp.stack([_sketch_row(spec, v_s, r) for r in range(spec.r)])
+
+
+# ---------------------------------------------------------------------------
+# estimate kernel (transposed direction) + median-of-r
+# ---------------------------------------------------------------------------
+
+
+def _estimate_row(spec, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
+    """Per-coordinate estimates of one row in chunk layout [nc, m]."""
+    g = _row_geom(spec, row)
+    TC, MT, m, m_pad = g["TC"], g["MT"], g["m"], g["m_pad"]
+    u, s, TB, nt = g["u"], g["s"], g["TB"], g["nt"]
+    slot_fn, sign_fn = _row_hashes(spec, row)
+    nj = m_pad // MT
+
+    # windows stack: tile i reads row positions [i*TC*s, i*TC*s + TB) — the
+    # only overlapping-window view; one small gather outside the kernel
+    # keeps every BlockSpec plainly blocked.
+    row_len = (g["nc_pad"] + u - 1) * s
+    row_p = jnp.pad(table_row[: min(table_row.shape[0], row_len)],
+                    (0, max(0, row_len - table_row.shape[0])))
+    win = jax.vmap(
+        lambda i: jax.lax.dynamic_slice(row_p, (i * TC * s,), (TB,))
+    )(jnp.arange(nt))
+
+    def kernel(in_ref, out_ref):
+        base = pl.program_id(0) * TC
+        blk = in_ref[0, :].reshape(TC + u - 1, s)
+
+        def body(j, _):
+            o = jax.lax.broadcasted_iota(jnp.uint32, (1, MT), 1) + (
+                jnp.uint32(MT) * j.astype(jnp.uint32)
+            )
+            h = slot_fn(o)  # [1, MT] in-window buckets
+            est = jnp.zeros((TC, MT), jnp.float32)
+            for sh in range(u):
+                # transposed one-hot for window slice sh: [s, MT]
+                v_ids = jax.lax.broadcasted_iota(jnp.int32, (s, MT), 0) + sh * s
+                ohT = (v_ids == h).astype(spec.dtype)
+                est = est + jax.lax.dot_general(
+                    blk[sh : sh + TC, :].astype(spec.dtype),
+                    ohT,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            out_ref[:, pl.ds(j * MT, MT)] = est * _sign_tile(
+                sign_fn, base, m, TC, MT, j
+            )
+            return 0
+
+        jax.lax.fori_loop(0, nj, body, 0)
+
+    est = pl.pallas_call(
+        kernel,
+        grid=(nt,),
+        in_specs=[pl.BlockSpec((1, TB), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((TC, m_pad), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((g["nc_pad"], m_pad), jnp.float32),
+        interpret=_interpret(),
+    )(win)
+    return est[: g["nc"], :m]
+
+
+def median_rows_pallas(ests: jnp.ndarray) -> jnp.ndarray:
+    """Median over axis 0 of an [r, n] stack as one tiled kernel pass —
+    an oblivious compare-exchange sort of the r lanes (r is small and
+    static), exact median for odd r and mean-of-middle-two for even r,
+    matching ``jnp.median``/``_median_rows``."""
+    r, n = ests.shape
+    if r == 1:
+        return ests[0]
+    TD = min(1 << 16, _ceil_mult(n, 1024))
+    n_pad = _ceil_mult(n, TD)
+    x = jnp.pad(ests, ((0, 0), (0, n_pad - n)))
+
+    def kernel(in_ref, out_ref):
+        rows = [in_ref[k : k + 1, :] for k in range(r)]
+        for a in range(r):  # selection compare-exchange network
+            for b in range(a + 1, r):
+                lo = jnp.minimum(rows[a], rows[b])
+                hi = jnp.maximum(rows[a], rows[b])
+                rows[a], rows[b] = lo, hi
+        if r % 2:
+            out_ref[:] = rows[r // 2]
+        else:
+            out_ref[:] = 0.5 * (rows[r // 2 - 1] + rows[r // 2])
+
+    med = pl.pallas_call(
+        kernel,
+        grid=(n_pad // TD,),
+        in_specs=[pl.BlockSpec((r, TD), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((1, TD), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=_interpret(),
+    )(x)
+    return med[0, :n]
+
+
+def estimate_all_pallas(spec, table: jnp.ndarray) -> jnp.ndarray:
+    """Pallas backend of ``estimate_all``'s matmul path: per-row transposed
+    kernels, the median kernel across rows (in scrambled space), then ONE
+    unscramble — the full ``unsketch`` front end before top-k."""
+    _check_poly4_field(spec)
+    ests = jnp.stack(
+        [
+            _from_layout(spec, _estimate_row(spec, table[r], r), r)
+            for r in range(spec.r)
+        ]
+    )
+    return _unscramble(spec, median_rows_pallas(ests))

@@ -88,7 +88,22 @@ class FederatedSession:
                 band=cfg.sketch_band,
                 hash_family=cfg.hash_family,
                 m=cfg.sketch_m,
+                backend=cfg.sketch_backend,
             )
+            if (
+                cfg.sketch_backend == "pallas"
+                and jax.default_backend() != "tpu"
+            ):
+                import warnings
+
+                warnings.warn(
+                    "sketch_backend='pallas' off-TPU runs every kernel "
+                    "under Pallas INTERPRET mode — orders of magnitude "
+                    "slower than the einsum backend (fine for tests/"
+                    f"dryruns, hopeless for training at D={self.grad_size:,}"
+                    "). Use sketch_backend='einsum' on "
+                    f"{jax.default_backend()!r} hosts."
+                )
             # d/c against the REALIZED per-row width (the blocked layout
             # rounds the requested num_cols; VERDICT r3 weak 3 asked the
             # envelope check to use what the table actually is).

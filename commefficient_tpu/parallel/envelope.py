@@ -25,10 +25,11 @@ virtual_momentum 0.9, r = 5, 12-epoch runs):
     gamma=0.90  40 trains (0.9997) / 50 partial (0.35)     -> rho* ~ 45
 
 Two parameters reproduce all three cliffs: ``rho1 = 27``, ``phi = 0.26``
-(predicts 27 / 35.4 / 45.0). Held-out validation (r5, same harness,
+(predicts 27 / 35.23 / 44.56 — ``predicted_dc_max`` at gamma 1/0.95/0.9).
+Held-out validation (r5, same harness,
 ``runs/r5_envelope_heldout.log``): the model's predictions at
-gamma=0.925 (rho* ~ 39.8: d/c 35 trains, 45 fails) and gamma=0.85
-(rho* ~ 55: d/c 50 trains) are confirmed — see CHANGELOG_r5.
+gamma=0.925 (rho* = 39.76: d/c 35 trains, 45 fails) and gamma=0.85
+(rho* = 54.97: d/c 50 trains) are confirmed — see CHANGELOG_r5.
 
 Scope: fitted at k/c = 0.1 and rho = 0.9 on the quarter-scale CV task and
 consistent with the GPT-2-scale points (d/c 25 stable undecayed; d/c 40
@@ -51,8 +52,11 @@ def predicted_dc_max(error_decay: float, *, rho1: float = RHO1,
     """Fitted maximum stable realized d/c for a given ``error_decay``.
 
     ``rho_star(gamma) = rho1 * ((1 - gamma*(1-phi)) / phi)**2`` — the
-    error-bank steady-state model above. Monotone decreasing in gamma:
-    1.0 -> 27, 0.95 -> 35.4, 0.9 -> 45.0, 0.85 -> 55.4, 0.8 -> 66.5.
+    error-bank steady-state model above. Monotone decreasing in gamma
+    (values from this function, 2 decimals): 1.0 -> 27.00, 0.95 -> 35.23,
+    0.9 -> 44.56, 0.85 -> 54.97, 0.8 -> 66.49. (ADVICE r5 #1: earlier
+    docs quoted hand-rounded grid points 35.4/45.0/55.4/66.5 that drifted
+    from the function — these are now regenerated from it.)
     """
     g = float(error_decay)
     return rho1 * ((1.0 - g * (1.0 - phi)) / phi) ** 2

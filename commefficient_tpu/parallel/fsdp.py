@@ -84,6 +84,7 @@ from commefficient_tpu.parallel.round import (
     sum_client_grads,
 )
 from commefficient_tpu.utils.config import Config
+from commefficient_tpu.utils.jax_compat import shard_map
 
 P = jax.sharding.PartitionSpec
 
@@ -231,7 +232,7 @@ def build_fsdp_round_fn(
         if cfg.momentum_dampening is not None
         else cfg.mode == "local_topk"
     )
-    grad_one = make_grad_one(cfg, loss_fn, unravel)
+    grad_one = make_grad_one(cfg, loss_fn, unravel, mesh)
     fused = (
         cfg.fuse_clients
         and cfg.max_grad_norm is None
@@ -318,7 +319,7 @@ def build_fsdp_round_fn(
     m_spec = (P(WORKERS) if dense else P()) if has_m else P()
     e_spec = (P(WORKERS) if dense else P()) if has_e else P()
     shard = P(WORKERS)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(shard, m_spec, e_spec, shard, shard, P(), P()),

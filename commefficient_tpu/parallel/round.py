@@ -74,6 +74,11 @@ from commefficient_tpu.ops.param_utils import clip_by_global_norm
 from commefficient_tpu.ops.topk import topk_dense, topk_threshold_dense
 from commefficient_tpu.parallel.mesh import WORKERS
 from commefficient_tpu.utils.config import Config
+from commefficient_tpu.utils.jax_compat import (
+    grad_extra_axes_psum,
+    pcast,
+    shard_map,
+)
 
 P = jax.sharding.PartitionSpec
 
@@ -149,12 +154,17 @@ def _validate(cfg: Config) -> None:
         )
 
 
-def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable):
+def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
     """Per-client gradient closure (the fed_worker forward_grad analog):
     ``(params_vec, batch, noise_rng) -> (flat grad [D], loss, aux)`` with
     weight decay, global-norm clip, and worker-side DP noise applied.
     Shared by the replicated round (build_round_fn) and the FSDP round
-    (parallel/fsdp.py) so the gradient semantics can never drift."""
+    (parallel/fsdp.py) so the gradient semantics can never drift.
+
+    ``mesh``: pass the round's mesh when the loss may shard its compute
+    over model/seq axes (tensor.build_tp_flat_loss) — on pre-vma JAX the
+    raw gradient is then explicitly psummed over those axes (see
+    utils.jax_compat.grad_extra_axes_psum; no-op on current JAX)."""
     f32 = jnp.float32
 
     def grad_one(params_vec, batch, noise_rng):
@@ -162,6 +172,7 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable):
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
         g, _ = ravel_pytree(grads)
         g = g.astype(f32)
+        g = grad_extra_axes_psum(g, mesh, WORKERS)
         if cfg.weight_decay:
             g = g + cfg.weight_decay * params_vec
         g = clip_by_global_norm(g, cfg.max_grad_norm)
@@ -287,7 +298,7 @@ def build_round_fn(
         _unsketch = partial(unsketch, approx=approx)
 
     # ---- per-client gradient (the fed_worker forward_grad analog) --------
-    grad_one = make_grad_one(cfg, loss_fn, unravel)
+    grad_one = make_grad_one(cfg, loss_fn, unravel, mesh)
 
     def local_sgd_delta(params_vec, batches, noise_rng, lr):
         """fedavg: num_local_iters SGD steps on the client's microbatches
@@ -339,7 +350,7 @@ def build_round_fn(
         # varying keeps AD shard-local, so per-client momentum/error/
         # compression below see each client's own gradient; aggregation then
         # happens exactly once, at the explicit psum.
-        params_vec = jax.lax.pcast(params_vec, WORKERS, to="varying")
+        params_vec = pcast(params_vec, WORKERS, to="varying")
 
         def per_client(b, cid, vel, err):
             noise_rng = jax.random.fold_in(rng, cid)
@@ -396,7 +407,7 @@ def build_round_fn(
         return agg, loss_mean, aux_sum, new_vel, new_err
 
     shard_spec = P(WORKERS)
-    worker_mapped = jax.shard_map(
+    worker_mapped = shard_map(
         worker_shard,
         mesh=mesh,
         in_specs=(P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P()),

@@ -9,12 +9,17 @@ O(T / seq) — the long-context capability the reference lacks (SURVEY.md §5
 "Long-context: Absent"; this is the documented TPU-native extension, not
 reference parity).
 
-Current integration status (honest): this is the standalone long-context
-forward/backward path, verified token-exact against the dense model in
-tests/test_ring_attention.py. The federated round engine still runs each
-client's model data-parallel only; fusing a ``seq`` axis into the round's
-``shard_map`` (workers x seq nested sharding of the per-client loss) is the
-next capability step and is NOT yet wired into gpt2_train.
+Integration status: this module is the STANDALONE long-context forward —
+``sp_gpt2_apply`` shard_maps the backbone by itself, verified token-exact
+against the dense model in tests/test_ring_attention.py. The federated
+round integration landed separately in ``tensor.build_tp_flat_loss``
+(which runs ring attention over ``seq`` INSIDE the round's
+workers x model x seq shard_map) and is wired into gpt2_train via the
+``--model_axis``/``--seq_axis`` flags (train/gpt2_train.py, the
+``cfg.model_axis > 1 or cfg.seq_axis > 1`` branch), exercised by the
+dp2 x tp2 x sp2 dryrun and tests/test_tensor_parallel.py. Use THIS module
+for long-context inference/eval outside the round engine; use the tensor.py
+loss for federated training.
 """
 
 from __future__ import annotations
@@ -27,6 +32,7 @@ import jax.numpy as jnp
 from commefficient_tpu.models.gpt2 import GPT2Backbone
 from commefficient_tpu.parallel.mesh import SEQ
 from commefficient_tpu.parallel.ring_attention import ring_attention
+from commefficient_tpu.utils.jax_compat import shard_map
 
 P = jax.sharding.PartitionSpec
 
@@ -59,7 +65,7 @@ def sp_gpt2_apply(mesh, model, params, input_ids, token_type_ids=None,
     if shape[-1] % seq_size != 0:
         raise ValueError(f"T={shape[-1]} must divide by seq axis {seq_size}")
     tspec = P(None, SEQ)
-    h = jax.shard_map(
+    h = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(), tspec, tspec if tt is not None else None),

@@ -14,6 +14,15 @@ Run-command parity examples:
       --error_type virtual --num_workers 8 --num_devices 8    # BASELINE #2
   python -m commefficient_tpu.train.cv_train --dataset_name femnist \
       --mode local_topk --error_type local --num_clients 100  # BASELINE #3
+
+Sketch kernels: ``--sketch_backend pallas`` runs the CountSketch matmul
+path as tiled Pallas TPU kernels (ops/pallas/ — in-kernel hashes/signs,
+fused overlap-add; same tables as the default einsum backend to fp32
+rounding). ``--hash_family poly4`` under the pallas backend works at any
+scale whose PADDED layout stays under 2^31 - 1 — GPT-2-small's D=124M
+included; beyond ~1.4e9 params the kernel raises a clear ValueError (the
+4-universal family lives in GF(2^31-1)). The einsum path materializes a
+host-side [d_eff] sign vector and is CV-scale-only for poly4.
 """
 
 from __future__ import annotations

@@ -19,6 +19,16 @@ Run-command parity examples:
   python -m commefficient_tpu.train.gpt2_train --model gpt2_tiny \
       --num_epochs 2 --num_workers 2 --num_devices 1         # CPU smoke
 
+  python -m commefficient_tpu.train.gpt2_train --mode sketch --k 50000 \
+      --num_rows 5 --num_cols 5000000 --virtual_momentum 0.9 \
+      --error_type virtual --sketch_backend pallas            # Pallas kernels
+      # sketch_backend=pallas: the CountSketch matmul path runs as tiled
+      # Pallas TPU kernels (ops/pallas/) — hashes/signs/one-hots generated
+      # in-kernel, targeting the r5 GPT-2 sketch-round gap; also lifts
+      # --hash_family poly4 (the 4-universal guarantee class) to D=124M.
+      # Identical tables/estimates to the default einsum backend up to
+      # fp32 rounding (checkpoints are backend-portable).
+
 Sketch sizing at GPT-2 scale: keep ``num_cols >= D/25`` (~5M for
 GPT-2-small, ~5x upload compression — the reference's own GPT-2 run
 compresses ~3.9x uplink). The r3 lab measured d/c >= 50 DIVERGING under

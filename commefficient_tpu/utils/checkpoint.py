@@ -112,7 +112,17 @@ class FedCheckpointer:
         still fails safe."""
         try:
             meta = self.mngr.item_metadata(step)
-            return "sketch_layout" not in meta
+            # ADVICE r5 #2: orbax returns a Mapping here in some versions
+            # and an iterable-of-keys view in others — normalize before
+            # membership tests so the probe is not version-coupled.
+            keys = set(meta.keys()) if hasattr(meta, "keys") else set(meta)
+            if not {"fed_state", "grad_size"} <= keys:
+                # every checkpoint this module ever wrote has these
+                # siblings; their absence means the probe surfaced some
+                # OTHER structure (or a corrupt item) — do not classify
+                # the stamp's absence as "pre-stamp" from it.
+                return "sketch_layout" in str(exc)
+            return "sketch_layout" not in keys
         except Exception:  # noqa: BLE001 — probe is best-effort
             return "sketch_layout" in str(exc)
 

@@ -174,9 +174,22 @@ class Config:
     sketch_m: Optional[int] = None
     # Hash family: "fmix32" (production default) or "poly4" — seed-derived
     # 4-universal Mersenne polynomials, the reference csvec's guarantee
-    # class, for lab A/B runs against fmix32 (CV scale; see
-    # ops/countsketch.py CountSketch.hash_family).
+    # class, for lab A/B runs against fmix32 (see
+    # ops/countsketch.py CountSketch.hash_family). With
+    # sketch_backend="einsum" poly4 is CV-scale-only (host-materialized
+    # [d_eff] sign vector); sketch_backend="pallas" evaluates the
+    # polynomial in-kernel and lifts poly4 to GPT-2 scale.
     hash_family: str = "fmix32"
+    # CountSketch kernel backend for the matmul-path ops ("einsum" |
+    # "pallas"). "einsum" (default): the banded one-hot einsum +
+    # overlap-add — runs everywhere, the r1-r5 production path. "pallas":
+    # tiled Pallas TPU kernels (ops/pallas/countsketch_kernels.py) that
+    # generate hashes/signs/one-hots on the fly inside the kernel — no
+    # [m, V] one-hot constant, no [nc, V] HBM round-trip, no [d_eff] sign
+    # vector; targets the GPT-2-scale sketch-round gap (BENCH_r05:
+    # sketch 0.50 s vs uncompressed 0.14 s). On CPU hosts the Pallas path
+    # runs under interpret mode (slow; for tests/labs, not production).
+    sketch_backend: str = "einsum"
 
     # --- mesh axes beyond the reference (TPU-native; VERDICT r2 item 3) ---
     # The federated round's mesh is (workers=num_devices, model=model_axis,
@@ -237,6 +250,11 @@ class Config:
         if self.hash_family not in ("fmix32", "poly4"):
             raise ValueError(
                 f"hash_family must be fmix32|poly4, got {self.hash_family!r}"
+            )
+        if self.sketch_backend not in ("einsum", "pallas"):
+            raise ValueError(
+                "sketch_backend must be einsum|pallas, "
+                f"got {self.sketch_backend!r}"
             )
         if self.synthetic_variant not in (
             "flat", "concentrated", "concentrated_v2"

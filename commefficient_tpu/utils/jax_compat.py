@@ -1,0 +1,147 @@
+"""Version portability for the two JAX APIs the round engine leans on.
+
+The parallel layer is written against the current ``jax.shard_map`` +
+varying-manual-axes (vma) API: replicated inputs are explicitly marked
+``pcast(..., to="varying")`` where AD must stay shard-local (round.py's
+worker gradients), and left unvarying where the transpose's automatic
+psum over the axis is the wanted behavior (tensor.py's TP/SP loss).
+
+Older JAX (<= 0.4.x, e.g. the 0.4.37 in some lab containers) predates
+both names: ``shard_map`` lives in ``jax.experimental.shard_map`` and
+there is no vma system at all — in-body AD is always shard-local, which
+is exactly the semantics the vma code gets via its explicit
+``pcast(to="varying")``. So on old JAX:
+
+  * ``shard_map`` delegates to the experimental module with
+    ``check_rep=False`` (the rep checker is the part of the old API the
+    vma-era out_specs were never written for);
+  * ``pcast`` is the identity — the varying mark it would set is the
+    old default.
+
+The one semantic the old API cannot reproduce automatically is the
+UNVARYING side: grad-of-replicated-params auto-psumming over a mesh axis
+(tensor.py's model/seq loss relies on it — each model/seq shard computes
+only ITS slice of the backward, and current JAX's vma transpose inserts
+the psum that totals them). ``grad_extra_axes_psum`` below restores it
+explicitly on old JAX (and is a no-op on vma JAX, where an explicit psum
+on top of the automatic one would double-count). Everything on the
+``workers`` axis (the whole federated round) is exact under both APIs
+with no help.
+
+All parallel-layer call sites import ``shard_map``/``pcast`` from here
+instead of ``jax`` so the choice is made in one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+HAS_VMA = hasattr(jax, "shard_map")
+
+if HAS_VMA:
+    shard_map = jax.shard_map
+
+    if hasattr(jax.lax, "pcast"):
+
+        def pcast(x, axis_name, *, to):
+            return jax.lax.pcast(x, axis_name, to=to)
+
+    else:  # the 0.6.x window: shard_map is public but pcast is not yet
+        # in jax.lax — only the one-way pvary (unvarying -> varying),
+        # which is the only direction this codebase uses
+        def pcast(x, axis_name, *, to):
+            if to != "varying":
+                raise NotImplementedError(
+                    f"pcast(to={to!r}) needs jax.lax.pcast; this JAX only "
+                    "provides pvary (to='varying')"
+                )
+            return jax.lax.pvary(x, axis_name)
+
+else:  # pre-vma JAX
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+        kw.setdefault("check_rep", False)
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    def pcast(x, axis_name, *, to):  # noqa: ARG001 — signature parity
+        return x
+
+
+def grad_extra_axes_psum(g, mesh, primary_axis):
+    """Total a shard-local param-gradient over the mesh axes BEYOND the
+    data axis — only on pre-vma JAX, only when such axes exist.
+
+    Must be called INSIDE the round's shard_map, immediately after the
+    raw gradient (before weight decay / clipping / DP noise, which apply
+    to the TOTAL gradient exactly once). On vma JAX the value_and_grad
+    transpose already summed over the unvarying model/seq axes, so this
+    returns ``g`` untouched.
+
+    Why pmean and not psum: pre-vma JAX keeps the legacy cyclic transpose
+    ``T(psum) = psum`` inside shard_map bodies (the exact problem the vma
+    redesign solved), so the cotangent arriving below the loss's final
+    psum chain carries an extra factor of the axis size n — the per-shard
+    gradients SUM to n x the true total. Measured on the TP/SP GPT-2 loss
+    (model=2 / seq=2 / both): per-shard-sum norm is exactly n x the dense
+    reference, and the MEAN matches it to 1.6e-7 max over all params.
+    ``pmean`` therefore performs the correct totaling: psum / n.
+    """
+    if HAS_VMA or mesh is None:
+        return g
+    extra = tuple(
+        a
+        for a, n in zip(mesh.axis_names, mesh.devices.shape)
+        if a != primary_axis and n > 1
+    )
+    return jax.lax.pmean(g, extra) if extra else g
+
+
+def grads_unreplicated_pmean(grads, specs, mesh):
+    """Per-param version of the same correction for steps that apply their
+    update INSIDE the shard_map (tensor.build_tp3d_train_step): total each
+    gradient leaf over every mesh axis its param is REPLICATED on (absent
+    from its PartitionSpec), leaving sharded-axis grads shard-local.
+
+    No-op on vma JAX — there the transpose of an unvarying param already
+    inserts this psum. Pre-vma, two legacy-transpose inflations must be
+    undone (both measured EXACTLY on the tp3d step, pinned by
+    tests/test_tensor_parallel.py::test_tp3d_train_step_matches_single_
+    device_sgd):
+
+      * replicated axes: same calibration as ``grad_extra_axes_psum`` —
+        per-shard grads sum to n x the total, so their MEAN is the total
+        (pmean over the axes absent from the spec);
+      * sharded axes: the cotangent of a row/column-parallel param crosses
+        that axis's activation psum exactly ONCE on every path (the
+        Megatron pattern tensor.py uses — no compounding through the
+        residual stream; measured ratio is exactly the axis size for
+        every sharded leaf), and nothing averages it back out because the
+        shard keeps its own slice — divide by the axis size explicitly.
+
+    Must be called inside the shard_map body, on the raw grads, before
+    the update."""
+    if HAS_VMA or mesh is None:
+        return grads
+
+    def one(g, spec):
+        used = set()
+        for part in spec:
+            if part is None:
+                continue
+            used.update(part if isinstance(part, tuple) else (part,))
+        extra, sharded_n = (), 1
+        for a, n in zip(mesh.axis_names, mesh.devices.shape):
+            if n <= 1:
+                continue
+            if a in used:
+                sharded_n *= n
+            else:
+                extra += (a,)
+        if extra:
+            g = jax.lax.pmean(g, extra)
+        return g / sharded_n if sharded_n > 1 else g
+
+    return jax.tree.map(one, grads, specs)

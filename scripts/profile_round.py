@@ -2,10 +2,14 @@
 
 Times each stage of the federated sketch round separately with scalar-fetch
 fences (block_until_ready is unreliable through the axon tunnel), so the
-perf work attacks measured hot spots instead of guesses. Run WITHOUT the
-test conftest so it dials the real TPU:
+perf work attacks measured hot spots instead of guesses. The sketch /
+estimate / unsketch phases are timed for BOTH CountSketch backends
+(einsum and pallas — ops/pallas/) so the r5 sketch-round gap is tracked
+at phase granularity. Run WITHOUT the test conftest so it dials the real
+TPU:
 
-    python scripts/profile_round.py [--dtype bfloat16] [--reps 10]
+    python scripts/profile_round.py [--dtype bfloat16] [--reps 10] \
+        [--sketch_backend pallas]
 """
 
 from __future__ import annotations
@@ -44,6 +48,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument(
+        "--sketch_backend", default="einsum", choices=("einsum", "pallas"),
+        help="backend for the full-round ground-truth section; the "
+        "per-phase sketch/unsketch breakdown always times BOTH backends",
+    )
     args = ap.parse_args()
 
     from commefficient_tpu.models import ResNet9, classification_loss
@@ -92,37 +101,60 @@ def main():
     from commefficient_tpu.ops.countsketch import unsketch_dense
     from commefficient_tpu.ops.topk import topk_threshold_dense
 
-    sketch_j = jax.jit(lambda v: sketch_vec(spec, v))
-    est_j = jax.jit(lambda t: estimate_all(spec, t))
     topk_j = jax.jit(lambda e: jax.lax.top_k(jnp.abs(e), k)[1])
     approx_j = jax.jit(lambda e: jax.lax.approx_max_k(jnp.abs(e), k)[1])
     thr_j = jax.jit(lambda e: topk_threshold_dense(e, k))
     ssp_j = jax.jit(lambda i, va: sketch_sparse(spec, i, va))
-    unsk_j = jax.jit(lambda t: unsketch_sparse(spec, t, k))
-    unskd_j = jax.jit(lambda t: unsketch_dense(spec, t, k))
     scatter_j = jax.jit(lambda i, va: jnp.zeros(d, jnp.float32).at[i].set(va))
-
-    table = sketch_j(v)
-    est = est_j(table)
 
     r = args.reps
     timeit(f"fwd+bwd batch {workers*batch} (monolithic)", fwd_bwd, vec, x, y, reps=r)
     t_modelw = timeit(f"fwd+bwd {workers}x{batch} (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
-    t_sk = timeit("sketch_vec (dense d)", sketch_j, v, reps=r)
-    timeit("estimate_all", est_j, table, reps=r)
-    timeit("lax.top_k k=50k over d", topk_j, est, reps=r)
-    timeit("approx_max_k k=50k over d", approx_j, est, reps=r)
-    t_thr = timeit("topk_threshold_dense k=50k", thr_j, est, reps=r)
-    timeit("sketch_sparse k=50k (scatter)", ssp_j, idx, vals, reps=r)
-    timeit("unsketch_sparse (est+top_k)", unsk_j, table, reps=r)
-    t_unskd = timeit("unsketch_dense (est+threshold)", unskd_j, table, reps=r)
-    timeit("dense scatter of k", scatter_j, idx, vals, reps=r)
 
-    total = t_modelw + t_sk + t_unskd + t_sk
-    print(f"\nround ≈ model {t_modelw:.1f} + sketch {t_sk:.1f} + "
-          f"unsketch_dense {t_unskd:.1f} + resketch {t_sk:.1f} = {total:.1f} ms")
-    print(f"-> {workers * batch / total * 1e3:,.0f} samples/s "
-          f"(bench does {workers * batch}/round)")
+    # -- sketch/unsketch phase split, BOTH backends ------------------------
+    # (the r5 VERDICT gap is a kernel property: the einsum path pays the
+    # [m, V] one-hot constant + [nc, V] HBM round-trip + [d_eff] signs,
+    # the Pallas path generates all three on the fly in-kernel). Off-TPU
+    # the pallas legs run under interpret mode — minutes per call at this
+    # d, meaningless as perf data — so they auto-skip there (same policy
+    # as bench.py's GPT-2 legs; --sketch_backend pallas forces them).
+    backends = ("einsum", "pallas")
+    if jax.devices()[0].platform != "tpu" and args.sketch_backend != "pallas":
+        print("[pallas] phase legs skipped on non-TPU host "
+              "(pass --sketch_backend pallas to force interpret-mode timing)")
+        backends = ("einsum",)
+    phase = {}
+    for backend in backends:
+        sp = spec._replace(backend=backend)
+        sketch_j = jax.jit(lambda v, sp=sp: sketch_vec(sp, v))
+        est_j = jax.jit(lambda t, sp=sp: estimate_all(sp, t))
+        unsk_j = jax.jit(lambda t, sp=sp: unsketch_sparse(sp, t, k))
+        unskd_j = jax.jit(lambda t, sp=sp: unsketch_dense(sp, t, k))
+        table = sketch_j(v)
+        est = est_j(table)
+        t_sk = timeit(f"[{backend}] sketch_vec (dense d)", sketch_j, v, reps=r)
+        t_est = timeit(f"[{backend}] estimate_all", est_j, table, reps=r)
+        timeit(f"[{backend}] unsketch_sparse (est+top_k)", unsk_j, table, reps=r)
+        t_unskd = timeit(f"[{backend}] unsketch_dense (est+threshold)",
+                         unskd_j, table, reps=r)
+        phase[backend] = (t_sk, t_est, t_unskd)
+        if backend == "einsum":
+            # selection-kernel lines are backend-independent (they consume
+            # the estimate vector) — time them once
+            timeit("lax.top_k k=50k over d", topk_j, est, reps=r)
+            timeit("approx_max_k k=50k over d", approx_j, est, reps=r)
+            timeit("topk_threshold_dense k=50k", thr_j, est, reps=r)
+            timeit("sketch_sparse k=50k (scatter)", ssp_j, idx, vals, reps=r)
+            timeit("dense scatter of k", scatter_j, idx, vals, reps=r)
+
+    print()
+    for backend, (t_sk, t_est, t_unskd) in phase.items():
+        total = t_modelw + t_sk + t_unskd + t_sk
+        print(f"[{backend}] round ≈ model {t_modelw:.1f} + sketch {t_sk:.1f} "
+              f"+ unsketch_dense {t_unskd:.1f} (est {t_est:.1f} + select "
+              f"{t_unskd - t_est:.1f}) + resketch {t_sk:.1f} = {total:.1f} ms"
+              f" -> {workers * batch / total * 1e3:,.0f} samples/s "
+              f"(bench does {workers * batch}/round)")
 
     # ground truth: the EXACT bench config (bench.py r2: fuse_clients,
     # batch 256, num_blocks 1) so this number reconciles against bench.py
@@ -134,7 +166,8 @@ def main():
                  k=k, num_rows=5, num_cols=500_000, num_blocks=1,
                  topk_method="threshold", fuse_clients=True,
                  num_clients=2 * workers, num_workers=workers, num_devices=1,
-                 local_batch_size=bench_batch, weight_decay=5e-4)
+                 local_batch_size=bench_batch, weight_decay=5e-4,
+                 sketch_backend=args.sketch_backend)
     session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
     ids = jnp.arange(workers, dtype=jnp.int32)
     data = {"x": jnp.asarray(rng.normal(
@@ -157,7 +190,7 @@ def main():
     state, losses = run_rounds(state)
     fence(losses)
     dt = (time.perf_counter() - t0) / n * 1e3
-    print(f"scanned full round: {dt:.2f} ms -> "
+    print(f"scanned full round [{args.sketch_backend}]: {dt:.2f} ms -> "
           f"{workers * bench_batch / dt * 1e3:,.0f} samples/s")
 
 

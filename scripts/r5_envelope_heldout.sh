@@ -12,10 +12,18 @@ mkdir -p runs
 log() { echo "== $*" | tee -a runs/r5_envelope_heldout.log; }
 
 run() {
+  # ADVICE r5 #5: check the pipeline status — a crashed lab run used to
+  # have its traceback tail captured as if it were a result row.
   local name="$1"; shift
-  out=$(python scripts/sketch_lab.py --num_epochs 12 --lr_scale 0.04 \
-        --pivot_epoch 2 --virtual_momentum 0.9 "$@" 2>&1 | tail -2)
-  log "$name: $out"
+  local out rc
+  out=$(set -o pipefail; python scripts/sketch_lab.py --num_epochs 12 \
+        --lr_scale 0.04 --pivot_epoch 2 --virtual_momentum 0.9 "$@" 2>&1 \
+        | tail -2); rc=$?
+  if [ "$rc" -ne 0 ]; then
+    log "$name: FAILED (exit $rc) — last output: $out"
+  else
+    log "$name: $out"
+  fi
 }
 
 run "dc35_decay0.925_predict_TRAIN" --c_div 35 --k_div 350 --error_decay 0.925

@@ -41,6 +41,19 @@ def test_validation():
         Config(num_clients=2, num_workers=8)
     with pytest.raises(ValueError):
         Config(synthetic_variant="bogus")
+    with pytest.raises(ValueError, match="sketch_backend"):
+        Config(sketch_backend="cuda")
+
+
+def test_sketch_backend_cli_reaches_spec():
+    # the backend flag must flow CLI -> Config -> CountSketch (the Pallas
+    # dispatch is a spec property, ops/countsketch.py)
+    cfg = parse_args(["--sketch_backend", "pallas"])
+    assert cfg.sketch_backend == "pallas"
+    from commefficient_tpu.ops.countsketch import CountSketch
+
+    spec = CountSketch(d=1000, c=200, r=3, backend=cfg.sketch_backend)
+    assert spec.backend == "pallas"
 
 
 def test_sketch_dampening_gated():
