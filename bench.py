@@ -130,6 +130,11 @@ def _measure_gpt2(mode: str, n_rounds: int = 10, sketch_backend: str = "einsum")
                      virtual_momentum=0.9, k=50_000, num_rows=5,
                      num_cols=5_000_000, sketch_backend=sketch_backend,
                      **base)
+    elif mode == "powersgd":
+        # rank-4 warm-started PowerSGD (compress/powersgd.py): D=124M
+        # matricizes ~[11.2k, 11.2k], downlink r*(n+m) ~ 89k floats
+        cfg = Config(mode="powersgd", error_type="virtual",
+                     virtual_momentum=0.9, powersgd_rank=4, **base)
     else:
         cfg = Config(mode="uncompressed", virtual_momentum=0.9, **base)
     session = FederatedSession(cfg, params, gpt2_double_heads_loss(model.apply),
@@ -286,6 +291,11 @@ def main():
             # master params / grads / sketch algebra stay f32 —
             # lab-validated accuracy parity (CHANGELOG_r3)
             "sketch_fused_bf16": base.replace(compute_dtype="bfloat16"),
+            # PR 2: rank-4 PowerSGD vs the sketch headline at the same
+            # round shape (server-side GS power iteration replaces the
+            # unsketch extract)
+            "powersgd_r4_fused": base.replace(mode="powersgd",
+                                              powersgd_rank=4),
         }
         for name, cfg in matrix.items():
             sps = _measure(cfg)
@@ -321,7 +331,10 @@ def main():
         # failure must not discard the measured legacy einsum rows, and
         # the CV headline must survive any of them.
         legs = [("uncompressed", "einsum", "gpt2_uncompressed"),
-                ("sketch", "einsum", "gpt2_sketch")]
+                ("sketch", "einsum", "gpt2_sketch"),
+                # per-mode leg (PR 2): the PowerSGD round rides the same
+                # line so its GS/matmul server cost is tracked vs the twins
+                ("powersgd", "einsum", "gpt2_powersgd")]
         if jax.default_backend() == "tpu":
             # the pallas kernels compile through Mosaic only on TPU; any
             # other backend (a GPU host forced past the cpu auto-skip)
@@ -342,7 +355,7 @@ def main():
             gpt2[f"{key}_tokens_per_sec"] = round(tps, 1)
             gpt2[f"{key}_mfu"] = round(gmfu, 4)
             gpt2[f"{key}_sec_per_round"] = round(spr, 4)
-        for key in ("gpt2_sketch", "gpt2_sketch_pallas"):
+        for key in ("gpt2_sketch", "gpt2_sketch_pallas", "gpt2_powersgd"):
             num = gpt2.get(f"{key}_tokens_per_sec")
             den = gpt2.get("gpt2_uncompressed_tokens_per_sec")
             if num is not None and den:

@@ -1,0 +1,91 @@
+"""Pluggable gradient-compression subsystem — every mode is a Compressor.
+
+The round engine (``parallel/round.py`` and ``parallel/fsdp.py``) used to
+hard-code the five modes' algebra inline in its dispatch; adding a sixth
+mode meant editing the jitted round by hand. This package is the extraction
+of that algebra into per-mode ``Compressor`` classes behind a registry keyed
+by ``cfg.mode`` (``registry.get_compressor``), so a new compressor is a
+one-file PR: subclass ``base.Compressor``, decorate with
+``@register("name")``, add the name to ``utils.config.MODES``.
+
+THE LINEAR-AGGREGATION CONTRACT (what makes a compressor psum-safe)
+-------------------------------------------------------------------
+
+Cross-worker aggregation is a single ``lax.psum`` over the ICI mesh axis of
+whatever ``device_encode`` returns. That psum is EXACT — not an
+approximation of the sum of per-worker updates — if and only if the encoded
+representation is **linear** in its input:
+
+    device_encode(x + y) == device_encode(x) + device_encode(y)
+    device_encode(a * x) == a * device_encode(x)
+
+Every registered mode satisfies this: the dense modes encode with the
+identity; ``sketch`` encodes with the CountSketch projection (a fixed
+linear map — FetchSGD's central trick, sketch-of-sum == sum-of-sketches);
+``local_topk`` transmits already-sparsified dense vectors (the
+sparsification is per-client, BEFORE the sum — the transmitted vectors
+themselves add linearly); ``powersgd``'s transmitted aggregate is the dense
+update whose server-side low-rank factorization is linear in it given the
+warm-start ``Q`` (``P = M @ Q``), the property arXiv:1905.13727 exploits for
+allreduce and arXiv:2201.07598 generalizes to sparse allreduce. A
+compressor whose encoding is NOT linear (e.g. per-worker quantization with
+data-dependent scales baked into the payload) cannot ride ``psum`` and does
+not fit this protocol — it would need gather-style aggregation instead.
+
+Nonlinear steps (top-k selection, Gram–Schmidt, unsketch-estimate medians)
+are legal anywhere EXCEPT between ``device_encode`` and the psum: per-client
+before the device sum (``client_transmit``) or at the server after the psum
+(``server_update``).
+
+Protocol (see ``base.Compressor`` for the full signatures):
+
+  * ``init_server_state()``      — (momentum, error, extra) FedState leaves
+  * ``client_grad(...)``         — per-client gradient rule (fedavg: local SGD)
+  * ``client_transmit(...)``     — per-client EF + sparsify (local_topk)
+  * ``device_encode(vec)``       — linear encode, once per device, pre-psum
+  * ``server_update(...)``       — momentum/error algebra + extract, post-psum
+  * ``fsdp_update(...)``         — the sharded-state server path (optional)
+  * ``upload_floats()/download_floats()`` — bytes_per_round accounting
+
+Error-feedback semantics are the FetchSGD Algorithm-1 contract pinned by
+tests/test_round.py's varying-lr regressions: error banks **lr-scaled**
+updates (``e += lr * m``) and the extracted update applies WITHOUT a second
+lr; paths without error feedback apply ``lr * update`` at application time
+(equivalent for any schedule).
+
+Mode-string branching belongs HERE (and in ``utils/config.py``) and nowhere
+else — enforced by ``scripts/check_mode_dispatch.py``, which tier-1 runs via
+tests/test_mode_dispatch.py.
+"""
+
+from commefficient_tpu.compress.base import Compressor
+from commefficient_tpu.compress.registry import (
+    REGISTRY,
+    available_modes,
+    compressor_class,
+    get_compressor,
+    register,
+)
+
+# importing the backend modules self-registers them
+from commefficient_tpu.compress import (  # noqa: E402  isort: skip
+    dense,
+    local_topk,
+    powersgd,
+    sketch,
+    true_topk,
+)
+
+__all__ = [
+    "Compressor",
+    "REGISTRY",
+    "available_modes",
+    "compressor_class",
+    "get_compressor",
+    "register",
+    "dense",
+    "local_topk",
+    "powersgd",
+    "sketch",
+    "true_topk",
+]

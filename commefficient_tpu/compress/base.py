@@ -1,0 +1,184 @@
+"""Compressor base class — the protocol every mode implements.
+
+Layering: compress/ sits between ops/ (kernels it may use) and parallel/
+(the round engines that consume it). It therefore imports ONLY ops and jax;
+mesh axis names are passed in by the caller, and ``cfg`` is duck-typed (a
+``utils.config.Config``, but never imported here, so config.py may validate
+against the registry without a cycle).
+
+A compressor instance is a TRACE-TIME object: the round builders construct
+it once per compile and call its hooks while tracing, so every method body
+below runs under jit — keep them functional (no python-side state mutation
+beyond memoized resolution done before tracing starts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from commefficient_tpu.ops.countsketch import unsketch, unsketch_dense
+from commefficient_tpu.ops.topk import topk_dense, topk_threshold_dense
+
+# server-state leaf kinds (init_state / FSDP sharding decisions):
+#   None    — leaf absent (empty tuple in FedState)
+#   "dense" — [D] vector (FSDP shards it [D/W] over workers)
+#   "table" — [r, c] sketch table (small; FSDP keeps it replicated)
+KIND_NONE = None
+KIND_DENSE = "dense"
+KIND_TABLE = "table"
+
+
+class Compressor:
+    """One compression mode's full algebra. Subclass + ``@register``."""
+
+    name: str = "?"  # stamped by @register
+    # (mode, error_type) support table — the legacy _validate contract
+    allowed_error_types: Tuple[str, ...] = ("none",)
+    # False -> the FSDP round refuses this mode with a pointer to the
+    # memory-wall knob that DOES apply (offload_client_state for local
+    # modes); True -> the class implements fsdp_update()
+    supports_fsdp: bool = False
+    # True -> FederatedSession builds a CountSketch spec and passes it in
+    needs_sketch_spec: bool = False
+    # True -> the fused flattened-batch gradient fast path is mathematically
+    # identical for this mode (nothing per-client in the transmit rule)
+    supports_fused_clients: bool = False
+    # True -> the applied delta is dense, so do_topk_down's downlink top-k
+    # is meaningful (sketch/true_topk deltas already have <= k nonzeros;
+    # powersgd's delta is rank-r factored)
+    dense_delta: bool = True
+    # momentum_dampening=None (AUTO) resolves to this (r4 four-corner
+    # evidence; see resolved_dampening overrides for the per-mode warnings)
+    default_dampening: bool = False
+
+    def __init__(self, cfg, d: int, spec=None):
+        self.cfg = cfg
+        self.d = d
+        self.spec = spec
+        # top-k selection kernel (cfg.topk_method): "threshold" is the TPU
+        # fast path — no sort, no scatter (ops.topk.topk_threshold_dense)
+        if cfg.topk_method == "threshold":
+            self.topk = topk_threshold_dense
+            self.unsketch = lambda sp, t, k: unsketch_dense(sp, t, k)  # noqa: E731
+        else:
+            approx = cfg.topk_method == "approx"
+            self.topk = partial(topk_dense, approx=approx)
+            self.unsketch = partial(unsketch, approx=approx)
+        self._dampen: Optional[bool] = None
+
+    # ---- validation ------------------------------------------------------
+    def validate(self) -> None:
+        """Raise on unsupported (mode, error_type) combinations — the
+        reference-supported table, NotImplementedError for API parity with
+        the legacy round's _validate."""
+        if self.cfg.error_type not in self.allowed_error_types:
+            raise NotImplementedError(
+                f"(mode={self.name}, error_type={self.cfg.error_type}) is "
+                f"not a reference-supported combination; allowed: "
+                f"{self.allowed_error_types}"
+            )
+
+    def validate_fsdp(self) -> None:
+        """FSDP-specific constraints; base refusal points at the knob that
+        addresses this mode's memory wall instead."""
+        if not self.supports_fsdp:
+            raise NotImplementedError(
+                f"fsdp supports server-state modes (uncompressed/true_topk/"
+                f"sketch); mode={self.name} keeps per-client "
+                "[num_clients, D] state — use offload_client_state for "
+                "that memory wall"
+            )
+
+    # ---- dampening -------------------------------------------------------
+    def resolved_dampening(self, warn: bool = True) -> bool:
+        """Resolve momentum_dampening AUTO (None) for this mode, emitting
+        the mode's evidence/parity warnings when ``warn`` (the replicated
+        round builder warns; FSDP resolves silently, matching its legacy
+        inline resolution). Memoized so repeated hook calls are free."""
+        if self._dampen is None:
+            md = self.cfg.momentum_dampening
+            self._dampen = md if md is not None else self.default_dampening
+            if warn:
+                self._dampening_warnings(self._dampen)
+        return self._dampen
+
+    def _dampening_warnings(self, dampen: bool) -> None:
+        pass
+
+    # ---- server state ----------------------------------------------------
+    def server_state_kinds(self) -> Tuple[Optional[str], Optional[str]]:
+        """(momentum_kind, error_kind) — drives allocation in init_state,
+        FSDP sharding specs, and the per-chip memory accounting."""
+        rho = self.cfg.virtual_momentum
+        return (KIND_DENSE if rho > 0 else KIND_NONE, KIND_NONE)
+
+    def init_server_state(self) -> Tuple[Any, Any, Any]:
+        """(momentum, error, extra) FedState leaves; () where absent.
+        ``extra`` is compressor-private warm state (powersgd's Q)."""
+        f32 = jnp.float32
+        m_kind, e_kind = self.server_state_kinds()
+        table = self.spec.table_shape if self.spec is not None else None
+
+        def alloc(kind):
+            if kind == KIND_DENSE:
+                return jnp.zeros((self.d,), f32)
+            if kind == KIND_TABLE:
+                return jnp.zeros(table, f32)
+            return ()
+
+        return alloc(m_kind), alloc(e_kind), self.init_extra_state()
+
+    def init_extra_state(self) -> Any:
+        return ()
+
+    # ---- worker side (inside shard_map) ----------------------------------
+    def client_grad(self, grad_one: Callable, params_vec, batch, noise_rng,
+                    lr):
+        """Per-client gradient rule: ``-> (g [D], loss, aux)``. Default is
+        one gradient pass; fedavg overrides with its local-SGD scan."""
+        return grad_one(params_vec, batch, noise_rng)
+
+    def client_transmit(self, u, err_row, lr):
+        """Per-client transmit rule AFTER local momentum:
+        ``-> (transmit [D], new_vel [D], new_err_row)``. Default transmits
+        the dense update and leaves client error untouched; local_topk
+        overrides with its error-feedback + top-k + dampening."""
+        return u, u, err_row
+
+    # ---- device side (inside shard_map, once per device) -----------------
+    def device_encode(self, local_sum):
+        """LINEAR encode of the device's summed transmit, applied once per
+        device just before the cross-worker psum (see the package docstring
+        for the psum-safety contract). Default: identity."""
+        return local_sum
+
+    # ---- server side -----------------------------------------------------
+    def server_update(self, momentum, error, extra, agg, lr, step):
+        """Server momentum/error algebra + update extraction:
+        ``-> (delta, new_momentum, new_error, new_extra)`` where ``delta``
+        is the APPLIED update (``w -= delta``). ``agg`` is the psum-averaged
+        (decoded-domain or encoded-domain) aggregate; ``step`` the round
+        counter (powersgd's non-warm-start Q derives from it)."""
+        raise NotImplementedError
+
+    # ---- FSDP (sharded server state) hooks -------------------------------
+    def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
+                    d, dp, S):
+        """Sharded server path, called INSIDE the FSDP round's shard_map
+        after the gradient: ``local`` is this device's dense transmit sum,
+        ``p_sh``/dense state are [S] = [dp/W] slices. Returns
+        ``(new_p_sh, new_momentum, new_error)``. Only classes with
+        ``supports_fsdp`` implement it."""
+        raise NotImplementedError
+
+    # ---- communication accounting (bytes_per_round) ----------------------
+    def upload_floats(self) -> int:
+        """Per-client uplink floats per round."""
+        return self.d
+
+    def download_floats(self) -> int:
+        """Downlink floats per round (before any do_topk_down top-k)."""
+        return self.d

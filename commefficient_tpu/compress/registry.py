@@ -1,0 +1,60 @@
+"""Compressor registry — the single place a mode string becomes code.
+
+``cfg.mode`` is looked up here exactly once per session/round build; from
+then on all dispatch is ordinary method calls on the returned instance, so
+the jitted round never branches on strings. ``utils.config.MODES`` mirrors
+the registered names for CLI validation/help; tests assert the two stay in
+sync (tests/test_mode_dispatch.py).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, Optional, Type
+
+if TYPE_CHECKING:  # layering: compress/ never imports config at runtime
+    from commefficient_tpu.compress.base import Compressor
+    from commefficient_tpu.ops.countsketch import CountSketch
+    from commefficient_tpu.utils.config import Config
+
+REGISTRY: Dict[str, Type["Compressor"]] = {}
+
+
+def register(name: str):
+    """Class decorator: ``@register("powersgd")`` puts the class on the
+    registry under ``name`` and stamps ``cls.name``."""
+
+    def deco(cls):
+        if name in REGISTRY:
+            raise ValueError(f"duplicate compressor registration: {name!r}")
+        cls.name = name
+        REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def available_modes() -> tuple:
+    return tuple(sorted(REGISTRY))
+
+
+def compressor_class(mode: str) -> Type["Compressor"]:
+    try:
+        return REGISTRY[mode]
+    except KeyError:
+        raise ValueError(
+            f"unknown compression mode {mode!r}; registered: "
+            f"{available_modes()}"
+        ) from None
+
+
+def get_compressor(
+    cfg: "Config", d: int, spec: Optional["CountSketch"] = None
+) -> "Compressor":
+    """Construct + validate the compressor for ``cfg.mode``.
+
+    ``d`` is the flat param dimension; ``spec`` the CountSketch layout for
+    modes whose class declares ``needs_sketch_spec`` (the caller owns spec
+    construction — see FederatedSession.__init__)."""
+    comp = compressor_class(cfg.mode)(cfg, d, spec=spec)
+    comp.validate()
+    return comp

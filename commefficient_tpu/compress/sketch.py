@@ -1,0 +1,142 @@
+"""``sketch`` — FetchSGD: CountSketch compression with sketched server state.
+
+The canonical linear compressor: each device sketches its summed transmit
+ONCE (``device_encode``), the psum of [r, c] tables IS the sketch of the
+global sum (linearity), and the server's momentum/error feedback run
+entirely in sketch space (FetchSGD Algorithm 1, arXiv:2007.07682) before a
+top-k unsketch extracts the applied update.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.compress.base import KIND_NONE, KIND_TABLE, Compressor
+from commefficient_tpu.compress.registry import register
+from commefficient_tpu.ops.countsketch import (
+    estimate_all,
+    estimate_at,
+    sketch_sparse,
+    sketch_vec,
+)
+from commefficient_tpu.ops.topk import topk_threshold_sharded
+
+
+@register("sketch")
+class SketchCompressor(Compressor):
+    allowed_error_types = ("none", "virtual")
+    supports_fsdp = True
+    needs_sketch_spec = True
+    supports_fused_clients = True
+    dense_delta = False  # the unsketched delta already has <= k nonzeros
+
+    def _dampening_warnings(self, dampen: bool) -> None:
+        if dampen:
+            import warnings
+
+            warnings.warn(
+                "momentum_dampening in sketch mode subtracts the sketch of "
+                "ESTIMATED momentum values; the estimate noise injected "
+                "into the momentum sketch every round measurably "
+                "destabilizes training at paper-scale settings (diverges "
+                "~step 70 where the unmasked run converges). FetchSGD's "
+                "Algorithm 1 does not mask sketched momentum — prefer "
+                "momentum_dampening=False here (dense modes mask exactly "
+                "and are unaffected)."
+            )
+
+    def validate_fsdp(self) -> None:
+        if self.cfg.momentum_dampening:
+            raise NotImplementedError(
+                "sketch momentum dampening is gated as unstable in the "
+                "replicated round already; not offered under fsdp"
+            )
+
+    def server_state_kinds(self):
+        cfg = self.cfg
+        return (
+            KIND_TABLE if cfg.virtual_momentum > 0 else KIND_NONE,
+            KIND_TABLE if cfg.error_type == "virtual" else KIND_NONE,
+        )
+
+    def device_encode(self, local_sum):
+        # one sketch per device; the psum over tables is exact by linearity
+        return sketch_vec(self.spec, local_sum)
+
+    def server_update(self, momentum, error, extra, agg, lr, step):
+        cfg, spec = self.cfg, self.spec
+        dampen = self.resolved_dampening()
+        rho = cfg.virtual_momentum
+        m = rho * momentum + agg if rho > 0 else agg
+        if cfg.error_type == "virtual":
+            e = error + lr * m
+            update = self.unsketch(spec, e, cfg.k)  # dense, <= k nonzeros
+            e = e - sketch_vec(spec, update)  # zero HH (linearity)
+            if cfg.error_decay != 1.0:
+                e = cfg.error_decay * e  # d/c-envelope mitigation
+            delta = update
+        else:
+            e = error
+            update = self.unsketch(spec, m, cfg.k)
+            delta = lr * update
+        if dampen and rho > 0:
+            # zero the momentum sketch at HH coords (fed_aggregator
+            # ~L380-440): estimate m there, subtract its sketch.
+            m_at_hh = jnp.where(update != 0, estimate_all(spec, m), 0.0)
+            m = m - sketch_vec(spec, m_at_hh)
+        new_m = m if rho > 0 else momentum
+        return delta, new_m, e, extra
+
+    def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
+                    d, dp, S):
+        cfg, spec = self.cfg, self.spec
+        rho = cfg.virtual_momentum
+        table = sketch_vec(spec, local)
+        agg = jax.lax.psum(table, axis_name) / W
+        # each chip estimates only its own D/W coordinate range via
+        # offset-indexed global hashes; the global top-k threshold uses
+        # scalar-only collectives (ops.topk.topk_threshold_sharded)
+        my = jax.lax.axis_index(axis_name)
+        idx = my * S + jnp.arange(S, dtype=jnp.int32)
+        in_range = (idx < d).astype(jnp.float32)
+        idx_c = jnp.minimum(idx, d - 1)
+        m = rho * m_in + agg if rho > 0 else agg
+        if cfg.error_type == "virtual":
+            e = e_in + lr * m
+            est = estimate_at(spec, e, idx_c) * in_range
+            upd = topk_threshold_sharded(est, cfg.k, axis_name)
+            # linearity: psum of per-shard slice sketches == sketch of the
+            # full extracted update (zero-HH error feedback)
+            e = e - jax.lax.psum(
+                sketch_sparse(spec, idx_c, upd), axis_name
+            )
+            if cfg.error_decay != 1.0:
+                e = cfg.error_decay * e
+            delta_sh = upd
+        else:
+            e = e_in
+            est = estimate_at(spec, m, idx_c) * in_range
+            delta_sh = lr * topk_threshold_sharded(est, cfg.k, axis_name)
+        new_m = m if rho > 0 else m_in
+        return p_sh - delta_sh, new_m, e
+
+    def upload_floats(self) -> int:
+        """The REALIZED table size ``r * c_actual`` (the blocked layout
+        rounds the requested num_cols to bucket-block multiples), not the
+        request (ADVICE r1: the request can silently understate the
+        payload)."""
+        r, c_actual = self.spec.table_shape
+        up = r * c_actual
+        requested = self.cfg.num_rows * self.cfg.num_cols
+        if up > 1.25 * requested:
+            import warnings
+
+            warnings.warn(
+                f"realized sketch table ({up} floats) exceeds the "
+                f"requested num_rows*num_cols ({requested}) by >25%: "
+                "the blocked layout's per-chunk bucket floor inflated "
+                "it — raise num_cols or chunk size m.",
+                stacklevel=2,
+            )
+        return up

@@ -1,0 +1,101 @@
+"""``true_topk`` — server-side top-k of the exact dense aggregate.
+
+Workers transmit dense gradients (uplink = D floats — the reference calls
+this mode federated for its DOWNLINK sparsity and its aggregation
+exactness); the server runs momentum + lr-scaled virtual error feedback on
+the dense [D] vectors and extracts a top-k update
+(fed_aggregator.py ``_server_helper_true_topk`` ~L440-480).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from commefficient_tpu.compress.base import KIND_DENSE, KIND_NONE, Compressor
+from commefficient_tpu.compress.registry import register
+from commefficient_tpu.ops.topk import topk_threshold_sharded
+
+
+@register("true_topk")
+class TrueTopkCompressor(Compressor):
+    allowed_error_types = ("none", "virtual")
+    supports_fsdp = True
+    supports_fused_clients = True
+    dense_delta = False  # delta already has <= k nonzeros; skip do_topk_down
+
+    def _dampening_warnings(self, dampen: bool) -> None:
+        cfg = self.cfg
+        if (
+            cfg.momentum_dampening is None
+            and (cfg.virtual_momentum > 0 or cfg.local_momentum > 0)
+        ):
+            # (at zero momentum masking is a no-op — nothing to warn about)
+            # ADVICE r4: AUTO here diverges from the reference's velocity-
+            # masking default (and has flipped across rounds) — surface it
+            # once so reference-parity runs notice rather than silently
+            # changing.
+            import warnings
+
+            warnings.warn(
+                "momentum_dampening=AUTO resolves to False for true_topk "
+                "(r4 four-corner evidence: unmasked 0.8923 vs masked 0.8595 "
+                "at tuned lr). The REFERENCE masks momentum here — pass "
+                "momentum_dampening=True explicitly for exact reference "
+                "parity."
+            )
+
+    def server_state_kinds(self):
+        # momentum is allocated even at rho=0: the server algebra runs
+        # ``m = rho*m + agg`` unconditionally (matches the legacy round)
+        virtual = self.cfg.error_type == "virtual"
+        return (KIND_DENSE, KIND_DENSE if virtual else KIND_NONE)
+
+    def server_update(self, momentum, error, extra, agg, lr, step):
+        cfg = self.cfg
+        dampen = self.resolved_dampening()
+        m = cfg.virtual_momentum * momentum + agg
+        if cfg.error_type == "virtual":
+            e = error + lr * m
+            update = self.topk(e, cfg.k)
+            e = e - update  # Ve[hh] = 0
+            if cfg.error_decay != 1.0:
+                e = cfg.error_decay * e
+            delta = update
+        else:
+            e = error
+            update = self.topk(m, cfg.k)
+            delta = lr * update
+        if dampen:
+            m = jnp.where(update != 0, 0.0, m)
+        return delta, m, e, extra
+
+    def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
+                    d, dp, S):
+        cfg = self.cfg
+        dampen = self.resolved_dampening(warn=False)
+        agg_sh = (
+            jax.lax.psum_scatter(
+                jnp.pad(local, (0, dp - d)), axis_name,
+                scatter_dimension=0, tiled=True,
+            )
+            / W
+        )
+        m = cfg.virtual_momentum * m_in + agg_sh
+        if cfg.error_type == "virtual":
+            e = e_in + lr * m
+            upd = topk_threshold_sharded(e, cfg.k, axis_name)
+            e = e - upd  # Ve[hh] = 0
+            if cfg.error_decay != 1.0:
+                e = cfg.error_decay * e
+            delta_sh = upd
+        else:
+            e = e_in
+            # dampening must mask on the UNSCALED selection (like the
+            # replicated round): at lr=0 (the schedule's final round) the
+            # scaled delta is all-zero but the selection is not
+            upd = topk_threshold_sharded(m, cfg.k, axis_name)
+            delta_sh = lr * upd
+        if dampen:
+            m = jnp.where(upd != 0, 0.0, m)
+        return p_sh - delta_sh, m, e

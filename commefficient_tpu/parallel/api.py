@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from commefficient_tpu.compress import compressor_class, get_compressor
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import ravel_params
 from commefficient_tpu.parallel.mesh import (
@@ -77,7 +78,10 @@ class FederatedSession:
         self.unravel = unravel
         self.grad_size = int(vec.size)  # args.grad_size analog
         self.spec = None
-        if cfg.mode == "sketch":
+        # mode dispatch happens exactly once, here, through the compress/
+        # registry; everything downstream calls compressor hooks
+        comp_cls = compressor_class(cfg.mode)
+        if comp_cls.needs_sketch_spec:
             self.spec = CountSketch(
                 d=self.grad_size,
                 c=cfg.num_cols,
@@ -148,6 +152,11 @@ class FederatedSession:
                     "this exact config with scripts/sketch_lab.py before a "
                     "long run."
                 )
+        # session-owned compressor instance: validates the (mode,
+        # error_type) combination up front and serves the communication
+        # accounting (bytes_per_round); the round builders construct their
+        # own trace-time instances from the same registry.
+        self.compressor = get_compressor(cfg, d=self.grad_size, spec=self.spec)
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
         if cfg.fsdp:
@@ -171,7 +180,9 @@ class FederatedSession:
                     self.host_vel = np.zeros((cfg.num_clients, self.grad_size), np.float32)
                 if needs_client_err(cfg):
                     self.host_err = np.zeros((cfg.num_clients, self.grad_size), np.float32)
-            self.round_fn = build_round_fn(cfg, loss_fn, unravel, self.mesh, self.spec)
+            self.round_fn = build_round_fn(
+                cfg, loss_fn, unravel, self.mesh, self.spec, d=self.grad_size
+            )
         # eval_fn: a prebuilt (params_vec, batch) -> metric-sums step — the
         # TP/SP eval path (tensor.build_tp_eval_fn) when the model needs the
         # model axis to fit; else the jit-replicated dense eval over
@@ -250,10 +261,10 @@ class FederatedSession:
         }
         raw_round = _brf(
             self.cfg, self._loss_fn, self.unravel, self.mesh, self.spec,
-            _jit=False,
+            _jit=False, d=self.grad_size,
         )
         has_aug = augment is not None
-        L = self.cfg.num_local_iters if self.cfg.mode == "fedavg" else 0
+        L = self.cfg.round_microbatches  # fedavg [W, L, B/L, ...] convention
 
         def round_idx_fn(state, data, client_ids, idx, plan, lr):
             W, B = idx.shape
@@ -392,33 +403,16 @@ class FederatedSession:
 
     def bytes_per_round(self) -> Dict[str, int]:
         """Upload/download bytes per participating client (BASELINE.md
-        accounting) — the headline communication metric. Sketch upload is the
-        REALIZED table size ``r * c_actual`` (the blocked layout rounds the
-        requested num_cols to bucket-block multiples), not the request
-        (ADVICE r1: the request can silently understate the payload)."""
-        d, k = self.grad_size, self.cfg.k
-        if self.cfg.mode == "sketch":
-            r, c_actual = self.spec.table_shape
-            up = r * c_actual
-            requested = self.cfg.num_rows * self.cfg.num_cols
-            if up > 1.25 * requested:
-                import warnings
-
-                warnings.warn(
-                    f"realized sketch table ({up} floats) exceeds the "
-                    f"requested num_rows*num_cols ({requested}) by >25%: "
-                    "the blocked layout's per-chunk bucket floor inflated "
-                    "it — raise num_cols or chunk size m.",
-                    stacklevel=2,
-                )
-        else:
-            up = {
-                "uncompressed": d,
-                "fedavg": d,
-                "true_topk": d,
-                "local_topk": 2 * k,
-            }[self.cfg.mode]
-        down = 2 * k if self.cfg.do_topk_down else d
+        accounting) — the headline communication metric, delegated to the
+        compressor (sketch reports the REALIZED ``r * c_actual`` table and
+        warns when the blocked layout inflates the request >25%, ADVICE r1;
+        powersgd's downlink is the factored ``r * (n + m)`` pair)."""
+        up = self.compressor.upload_floats()
+        down = (
+            2 * self.cfg.k
+            if self.cfg.do_topk_down
+            else self.compressor.download_floats()
+        )
         return {"upload_floats": up, "download_floats": down,
                 "upload_bytes": 4 * up, "download_bytes": 4 * down}
 

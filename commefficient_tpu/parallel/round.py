@@ -10,7 +10,9 @@ worker aggregation, and the server update — is ONE jitted function over a
 ``Mesh``:
 
   * worker processes      -> shards of a ``shard_map`` over the ``workers`` axis
-  * shm gradient gather   -> ``lax.psum`` over ICI (exact for sketches: linearity)
+  * shm gradient gather   -> ``lax.psum`` over ICI (exact for every
+                             registered compressor: the encoded transmit is
+                             linear by contract — see compress/)
   * ``ps_weights`` in shm -> replicated ``[D]`` param vector in HBM
   * per-client state rows -> ``[num_clients, D]`` arrays gathered/scattered
                              for the round's participants at the jit top level,
@@ -20,6 +22,13 @@ worker aggregation, and the server update — is ONE jitted function over a
                              num_clients*D in HBM)
   * server momentum/error -> dense ``[D]`` vectors or ``[r, c]`` sketch tables
                              carried in ``FedState``
+
+Since PR 2 the per-MODE algebra (what a client transmits, how a device
+encodes it before the psum, and the server's momentum/error/extract update)
+lives in ``commefficient_tpu/compress/`` behind a registry keyed by
+``cfg.mode``; this engine is mode-agnostic and calls the compressor's hooks
+at fixed points in the trace. Adding a compression mode no longer touches
+this file (enforced by scripts/check_mode_dispatch.py).
 
 Learning-rate semantics (DECISION, VERDICT r1 item 5): we follow FetchSGD's
 published Algorithm 1 (arXiv:2007.07682), not a guess at the reference's
@@ -37,41 +46,32 @@ piecewise-linear schedule; equivalent for constant lr by linearity —
 pinned by varying-lr regression tests in tests/test_round.py). Paths with
 no error feedback apply ``w -= lr * update`` at application time, which is
 equivalent for any schedule. Local error feedback (local_topk) banks
-``lr * u`` in the per-client error for the same reason.
+``lr * u`` in the per-client error for the same reason. Every compressor
+implements this contract (compress/ package docstring).
 
 fedavg scaling (DECISION, VERDICT r1 item 4): workers transmit
 ``(w - w_local_final) / local_lr`` (gradient scale, reference
 fed_worker.py ~L240-290 divides by the lr used locally) and the server
-applies ``lr * mean``. With ``local_lr=None`` (default) local steps run at
-the server schedule's current lr, so the net applied delta is EXACTLY the
-averaged weight delta — true FedAvg. An explicit ``local_lr`` decouples the
-two and scales the applied delta by ``lr/local_lr`` (documented deviation;
-asserted nowhere because it is sometimes wanted as a server step size).
+applies ``lr * mean`` — see compress/dense.py FedAvgCompressor.
 
-Supported (mode, error_type) pairs mirror the reference's use:
-  uncompressed/fedavg: error none;   true_topk/sketch: virtual or none;
-  local_topk: local or none.
+Supported (mode, error_type) pairs mirror the reference's use and are
+declared per compressor class (``allowed_error_types``):
+  uncompressed/fedavg: error none;   true_topk/sketch/powersgd: virtual or
+  none;   local_topk: local or none.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
+from commefficient_tpu.compress import get_compressor
 from commefficient_tpu.models.losses import IGNORE_INDEX
-from commefficient_tpu.ops.countsketch import (
-    CountSketch,
-    estimate_all,
-    sketch_vec,
-    unsketch,
-    unsketch_dense,
-)
+from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
-from commefficient_tpu.ops.topk import topk_dense, topk_threshold_dense
 from commefficient_tpu.parallel.mesh import WORKERS
 from commefficient_tpu.utils.config import Config
 from commefficient_tpu.utils.jax_compat import (
@@ -93,6 +93,7 @@ class FedState(NamedTuple):
     client_vel: Any = ()  # [num_clients, D] | () (host-side when offloaded)
     client_err: Any = ()  # [num_clients, D] | ()
     step: jnp.ndarray = None  # scalar int32
+    comp: Any = ()  # compressor-private warm state (powersgd's Q) | ()
 
 
 def needs_client_vel(cfg: Config) -> bool:
@@ -106,22 +107,14 @@ def needs_client_err(cfg: Config) -> bool:
 def init_state(cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch]) -> FedState:
     """Allocate exactly the state the (mode, error_type, momenta) combination
     needs — the analog of FedModel.__init__'s conditional shm allocation
-    (fed_aggregator.py ~L60-130). Client rows are allocated here only when
-    NOT offloaded to host (see FederatedSession for the offloaded path)."""
+    (fed_aggregator.py ~L60-130); shapes come from the compressor's
+    ``server_state_kinds``/``init_server_state``. Client rows are allocated
+    here only when NOT offloaded to host (see FederatedSession for the
+    offloaded path)."""
     d = params_vec.shape[0]
     f32 = jnp.float32
-    momentum: Any = ()
-    error: Any = ()
-    if cfg.mode == "sketch":
-        if cfg.virtual_momentum > 0:
-            momentum = jnp.zeros(spec.table_shape, f32)
-        if cfg.error_type == "virtual":
-            error = jnp.zeros(spec.table_shape, f32)
-    else:  # dense modes: uncompressed / fedavg / true_topk / local_topk
-        if cfg.virtual_momentum > 0 or cfg.mode == "true_topk":
-            momentum = jnp.zeros((d,), f32)
-        if cfg.mode == "true_topk" and cfg.error_type == "virtual":
-            error = jnp.zeros((d,), f32)
+    comp = get_compressor(cfg, d=d, spec=spec)
+    momentum, error, extra = comp.init_server_state()
     client_vel: Any = ()
     client_err: Any = ()
     if not cfg.offload_client_state:
@@ -136,22 +129,8 @@ def init_state(cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch]
         client_vel=client_vel,
         client_err=client_err,
         step=jnp.zeros((), jnp.int32),
+        comp=extra,
     )
-
-
-def _validate(cfg: Config) -> None:
-    ok = {
-        "uncompressed": ("none",),
-        "fedavg": ("none",),
-        "true_topk": ("none", "virtual"),
-        "sketch": ("none", "virtual"),
-        "local_topk": ("none", "local"),
-    }
-    if cfg.error_type not in ok[cfg.mode]:
-        raise NotImplementedError(
-            f"(mode={cfg.mode}, error_type={cfg.error_type}) is not a "
-            f"reference-supported combination; allowed: {ok[cfg.mode]}"
-        )
 
 
 def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
@@ -219,6 +198,8 @@ def build_round_fn(
     mesh,
     spec: Optional[CountSketch] = None,
     _jit: bool = True,
+    *,
+    d: Optional[int] = None,
 ):
     """Compile the per-round step.
 
@@ -226,7 +207,10 @@ def build_round_fn(
       loss_fn: ``(params_pytree, batch) -> (loss, aux_metrics)``.
       unravel: flat [D] vector -> params pytree (from ``ravel_params``).
       mesh: a Mesh with a ``workers`` axis of size cfg.num_devices.
-      spec: CountSketch spec (sketch mode only).
+      spec: CountSketch spec (modes whose compressor needs_sketch_spec).
+      d: flat param dimension, REQUIRED (compressor geometry, e.g.
+        powersgd's matricization) — pass ``ravel_params(params)[0].size``.
+        Keyword-only so legacy positional call sites fail loudly.
     Returns:
       With HBM-resident client state (default):
         ``round_fn(state, client_ids [W], batch {k: [W, ...]}, lr) ->
@@ -237,93 +221,23 @@ def build_round_fn(
         the caller owns the [num_clients, D] store (host RAM) and
         gathers/scatters the participants' rows around each call.
     """
-    _validate(cfg)
-    # momentum masking (dampening): AUTO (None) resolves per mode on the
-    # measured four-corner evidence (r4 lab, runs/r4_retune.log):
-    #   sketch     -> False  (FetchSGD Alg 1 does not mask sketched
-    #                 momentum; masking via noisy estimates diverges)
-    #   true_topk  -> False  (r4, v3 task, tuned lr per corner: unmasked
-    #                 0.8923 vs masked 0.8595 — the r1 "unmasked decays
-    #                 0.47 -> 0.10" overshoot was a property of the
-    #                 dense-SGD-hostile v2 task, not of the mode. The
-    #                 reference masks here; set momentum_dampening=True
-    #                 for exact reference behavior.)
-    #   local_topk -> True   (reference behavior; applies only with
-    #                 local momentum > 0; no contrary evidence)
-    dampen = (
-        cfg.momentum_dampening
-        if cfg.momentum_dampening is not None
-        else cfg.mode == "local_topk"
-    )
-    if (
-        cfg.momentum_dampening is None
-        and cfg.mode == "true_topk"
-        and (cfg.virtual_momentum > 0 or cfg.local_momentum > 0)
-    ):
-        # (at zero momentum masking is a no-op — nothing to warn about)
-        # ADVICE r4: AUTO here diverges from the reference's velocity-masking
-        # default (and has flipped across rounds) — surface it once so
-        # reference-parity runs notice rather than silently changing.
-        import warnings
-
-        warnings.warn(
-            "momentum_dampening=AUTO resolves to False for true_topk (r4 "
-            "four-corner evidence: unmasked 0.8923 vs masked 0.8595 at "
-            "tuned lr). The REFERENCE masks momentum here — pass "
-            "momentum_dampening=True explicitly for exact reference parity."
+    if d is None:
+        raise ValueError(
+            "build_round_fn requires d= (the flat param dimension); "
+            "pass ravel_params(params)[0].size"
         )
-    if cfg.mode == "sketch" and dampen:
-        import warnings
-
-        warnings.warn(
-            "momentum_dampening in sketch mode subtracts the sketch of "
-            "ESTIMATED momentum values; the estimate noise injected into "
-            "the momentum sketch every round measurably destabilizes "
-            "training at paper-scale settings (diverges ~step 70 where "
-            "the unmasked run converges). FetchSGD's Algorithm 1 does not "
-            "mask sketched momentum — prefer momentum_dampening=False "
-            "here (dense modes mask exactly and are unaffected)."
-        )
+    comp = get_compressor(cfg, d=d, spec=spec)
+    # momentum masking (dampening): AUTO (None) resolves per compressor on
+    # the measured four-corner evidence (r4 lab, runs/r4_retune.log) — see
+    # each compressor's default_dampening / _dampening_warnings in
+    # compress/ (sketch warns: FetchSGD Alg 1 does not mask sketched
+    # momentum; true_topk warns on AUTO: the reference masks there).
+    comp.resolved_dampening()
     W = cfg.num_workers
     f32 = jnp.float32
 
-    # top-k selection kernel (cfg.topk_method): "threshold" is the TPU fast
-    # path — no sort, no scatter (see ops.topk.topk_threshold_dense).
-    if cfg.topk_method == "threshold":
-        _topk = topk_threshold_dense
-        _unsketch = lambda sp, t, k: unsketch_dense(sp, t, k)  # noqa: E731
-    else:
-        approx = cfg.topk_method == "approx"
-        _topk = partial(topk_dense, approx=approx)
-        _unsketch = partial(unsketch, approx=approx)
-
     # ---- per-client gradient (the fed_worker forward_grad analog) --------
     grad_one = make_grad_one(cfg, loss_fn, unravel, mesh)
-
-    def local_sgd_delta(params_vec, batches, noise_rng, lr):
-        """fedavg: num_local_iters SGD steps on the client's microbatches
-        ({k: [L, B, ...]}); transmit the weight delta in gradient scale
-        (fed_worker ~L240-290). Local steps run at ``local_lr`` if set,
-        else at this round's server lr (see module docstring)."""
-        # guard lr == 0.0 exactly (the piecewise-linear schedule reaches 0 on
-        # the final round): local steps then take no step and the delta is 0,
-        # not 0/0 = NaN.
-        llr = (
-            jnp.float32(cfg.local_lr)
-            if cfg.local_lr is not None
-            else jnp.maximum(lr, 1e-12)
-        )
-
-        def one(carry, mb):
-            p, it = carry
-            g, loss, aux = grad_one(p, mb, jax.random.fold_in(noise_rng, it))
-            return (p - llr * g, it + 1), (loss, aux)
-
-        (p_final, _), (losses, auxes) = jax.lax.scan(
-            one, (params_vec, jnp.zeros((), jnp.int32)), batches
-        )
-        delta = (params_vec - p_final) / llr  # gradient-scale transmit
-        return delta, jnp.mean(losses), jax.tree.map(partial(jnp.mean, axis=0), auxes)
 
     lm = cfg.local_momentum
 
@@ -332,7 +246,7 @@ def build_round_fn(
     # is configured (sum of per-client mean-grads == w_loc * flat mean-grad).
     fused = (
         cfg.fuse_clients
-        and cfg.mode in ("uncompressed", "true_topk", "sketch")
+        and comp.supports_fused_clients
         and lm == 0
         and cfg.error_type != "local"
         and cfg.max_grad_norm is None
@@ -354,31 +268,17 @@ def build_round_fn(
 
         def per_client(b, cid, vel, err):
             noise_rng = jax.random.fold_in(rng, cid)
-            if cfg.mode == "fedavg":
-                g, loss, aux = local_sgd_delta(params_vec, b, noise_rng, lr)
-            else:
-                g, loss, aux = grad_one(params_vec, b, noise_rng)
+            g, loss, aux = comp.client_grad(
+                grad_one, params_vec, b, noise_rng, lr
+            )
             u = lm * vel + g if lm > 0 else g
-            new_vel = u
-            if cfg.mode == "local_topk":
-                # local error banks lr-scaled updates (module docstring);
-                # that transmit is applied by the server WITHOUT lr. With no
-                # error feedback the transmit stays in gradient scale and
-                # the server applies lr (equivalent for any schedule).
-                e = (err + lr * u) if cfg.error_type == "local" else u
-                t = _topk(e, cfg.k)
-                new_err = e - t
-                if dampen and lm > 0:
-                    new_vel = jnp.where(t != 0, 0.0, u)
-                transmit = t
-            else:  # sketch / uncompressed / true_topk / fedavg
-                # sketch mode also returns the DENSE u here: by linearity,
-                # sketch(sum of local clients' u) == sum of their sketches,
-                # so each device sketches ONCE below instead of per client
-                # (8x fewer sketches per chip; ICI still carries only the
-                # [r, c] table).
-                transmit = u
-                new_err = err
+            # the compressor's per-client transmit rule (local_topk: local
+            # error feedback + top-k + momentum masking). Dense-transmit
+            # modes return u itself: by linearity of device_encode,
+            # encode(sum of local clients' u) == sum of their encodings, so
+            # each device encodes ONCE below instead of per client (8x
+            # fewer sketches per chip; ICI still carries only the encoding).
+            transmit, new_vel, new_err = comp.client_transmit(u, err, lr)
             return transmit, new_vel, new_err, loss, aux
 
         w_loc = client_ids.shape[0]
@@ -399,8 +299,7 @@ def build_round_fn(
             local = jnp.sum(transmit, axis=0)
             loss_local = jnp.sum(loss)
             aux = jax.tree.map(lambda a: jnp.sum(a, 0), aux)
-        if cfg.mode == "sketch":
-            local = sketch_vec(spec, local)  # one sketch per device
+        local = comp.device_encode(local)  # linear -> psum below is exact
         agg = jax.lax.psum(local, WORKERS) / W
         loss_mean = jax.lax.psum(loss_local, WORKERS) / W
         aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)
@@ -413,55 +312,6 @@ def build_round_fn(
         in_specs=(P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P()),
         out_specs=(P(), P(), P(), shard_spec, shard_spec),
     )
-
-    # ---- server update (fed_aggregator _server_helper_* ~L380-540) -------
-    # Returns the APPLIED delta (w -= delta) plus new momentum/error state.
-    def server_update(state: FedState, agg, lr):
-        rho = cfg.virtual_momentum
-        if cfg.mode == "sketch":
-            m = rho * state.momentum + agg if rho > 0 else agg
-            if cfg.error_type == "virtual":
-                e = state.error + lr * m
-                update = _unsketch(spec, e, cfg.k)  # dense, ≤k nonzeros
-                e = e - sketch_vec(spec, update)  # zero HH (linearity)
-                if cfg.error_decay != 1.0:
-                    e = cfg.error_decay * e  # d/c-envelope mitigation
-                delta = update
-            else:
-                e = state.error
-                update = _unsketch(spec, m, cfg.k)
-                delta = lr * update
-            if dampen and rho > 0:
-                # zero the momentum sketch at HH coords (fed_aggregator
-                # ~L380-440): estimate m there, subtract its sketch.
-                m_at_hh = jnp.where(update != 0, estimate_all(spec, m), 0.0)
-                m = m - sketch_vec(spec, m_at_hh)
-            new_m = m if rho > 0 else state.momentum
-            return delta, new_m, e
-        if cfg.mode == "true_topk":
-            m = rho * state.momentum + agg
-            if cfg.error_type == "virtual":
-                e = state.error + lr * m
-                update = _topk(e, cfg.k)
-                e = e - update  # Ve[hh] = 0
-                if cfg.error_decay != 1.0:
-                    e = cfg.error_decay * e
-                delta = update
-            else:
-                e = state.error
-                update = _topk(m, cfg.k)
-                delta = lr * update
-            if dampen:
-                m = jnp.where(update != 0, 0.0, m)
-            return delta, m, e
-        # uncompressed / fedavg / local_topk: dense (or sparse-sum) update.
-        # local_topk with local error transmits lr-scaled values (see
-        # worker_shard), so the server must NOT multiply by lr again.
-        applies_lr = not (cfg.mode == "local_topk" and cfg.error_type == "local")
-        if rho > 0:
-            m = rho * state.momentum + agg
-            return (lr * m if applies_lr else m), m, state.error
-        return (lr * agg if applies_lr else agg), state.momentum, state.error
 
     def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(), err_rows=()):
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
@@ -482,21 +332,27 @@ def build_round_fn(
         agg, loss, aux, new_vel, new_err = worker_mapped(
             state.params_vec, batch, client_ids, vel_rows, err_rows, rng, lr
         )
-        delta, new_m, new_e = server_update(state, agg, lr)
-        if cfg.do_topk_down and cfg.mode in ("uncompressed", "fedavg", "local_topk"):
+        # ---- server update (fed_aggregator _server_helper_* ~L380-540):
+        # the compressor's momentum/error algebra + update extraction,
+        # returning the APPLIED delta (w -= delta)
+        delta, new_m, new_e, new_comp = comp.server_update(
+            state.momentum, state.error, state.comp, agg, lr, state.step
+        )
+        if cfg.do_topk_down and comp.dense_delta:
             # downlink compression (reference down-compression flag): the
             # broadcast weight delta is itself top-k sparsified, so the
             # download really is 2k floats (bytes_per_round accounting).
             # Lossy by design, as in the reference — coordinates dropped
             # here are NOT re-banked into client error. Skipped for
-            # sketch/true_topk whose delta already has <= k nonzeros (a
+            # compressors whose delta is already compressed (sketch/
+            # true_topk: <= k nonzeros; powersgd: rank-r factored — a
             # full-[D] selection there would be a pure waste).
-            delta = _topk(delta, cfg.k)
+            delta = comp.topk(delta, cfg.k)
         new_params = state.params_vec - delta
         metrics = {"loss": loss, **aux}
         if cfg.offload_client_state:
             new_state = FedState(
-                new_params, new_m, new_e, (), (), state.step + 1
+                new_params, new_m, new_e, (), (), state.step + 1, new_comp
             )
             return new_state, metrics, new_vel, new_err
         client_vel = (
@@ -508,7 +364,8 @@ def build_round_fn(
             else state.client_err
         )
         return (
-            FedState(new_params, new_m, new_e, client_vel, client_err, state.step + 1),
+            FedState(new_params, new_m, new_e, client_vel, client_err,
+                     state.step + 1, new_comp),
             metrics,
         )
 

@@ -14,6 +14,11 @@ Run-command parity examples:
       --error_type virtual --num_workers 8 --num_devices 8    # BASELINE #2
   python -m commefficient_tpu.train.cv_train --dataset_name femnist \
       --mode local_topk --error_type local --num_clients 100  # BASELINE #3
+  python -m commefficient_tpu.train.cv_train --mode powersgd \
+      --powersgd_rank 4 --error_type virtual --virtual_momentum 0.9 \
+      --num_workers 8 --num_devices 8        # PowerSGD low-rank (PR 2):
+      # rank-4 warm-started power iteration, ~320x downlink compression
+      # at ResNet-9 scale (see README mode table / compress/powersgd.py)
 
 Sketch kernels: ``--sketch_backend pallas`` runs the CountSketch matmul
 path as tiled Pallas TPU kernels (ops/pallas/ — in-kernel hashes/signs,
@@ -88,7 +93,8 @@ def build_model_and_data(cfg: Config):
         prep = device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
     elif cfg.dataset_name == "femnist":
         train, test, real = load_fed_emnist(
-            cfg.dataset_dir, num_clients=cfg.num_clients, seed=cfg.seed
+            cfg.dataset_dir, num_clients=cfg.num_clients, seed=cfg.seed,
+            label_noise=cfg.label_noise,
         )
         sample_shape = (1, 28, 28, 1)
         num_classes = 62
@@ -206,8 +212,8 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 metrics = session.train_round_indices(client_ids, idx, plan, lr)
             else:
                 client_ids, batch = item
-                if cfg.mode == "fedavg":
-                    L = cfg.num_local_iters
+                L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
+                if L:
                     batch = {
                         k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
                         for k, v in batch.items()
@@ -239,6 +245,10 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             writer.scalar("val/acc", val.get("accuracy", 0.0), step)
             writer.flush()
     profiler.close()
+    if not val:
+        # resumed at/after the final round (the epoch loop never ran):
+        # still evaluate so callers get final metrics instead of a KeyError
+        val = session.evaluate(test_ds.eval_batches(eval_batch_size))
     return val
 
 

@@ -19,6 +19,12 @@ Run-command parity examples:
   python -m commefficient_tpu.train.gpt2_train --model gpt2_tiny \
       --num_epochs 2 --num_workers 2 --num_devices 1         # CPU smoke
 
+  python -m commefficient_tpu.train.gpt2_train --mode powersgd \
+      --powersgd_rank 4 --error_type virtual --virtual_momentum 0.9 \
+      # PowerSGD (PR 2): D=124M matricizes ~[11.2k, 11.2k]; the rank-4
+      # factored downlink is ~89k floats (~1390x vs the dense delta) and
+      # the warm-start Q rides in FedState (README mode table)
+
   python -m commefficient_tpu.train.gpt2_train --mode sketch --k 50000 \
       --num_rows 5 --num_cols 5000000 --virtual_momentum 0.9 \
       --error_type virtual --sketch_backend pallas            # Pallas kernels
@@ -173,8 +179,8 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 metrics = session.train_round_indices(client_ids, idx, plan, lr)
             else:
                 client_ids, batch = item
-                if cfg.mode == "fedavg":
-                    L = cfg.num_local_iters
+                L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
+                if L:
                     batch = {
                         k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
                         for k, v in batch.items()
@@ -218,6 +224,10 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
             )
             print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
                   f"-> {gen.tolist()}")
+    if not val:
+        # resumed at/after the final round (the epoch loop never ran):
+        # still evaluate so callers get final metrics instead of a KeyError
+        val = evaluate_ppl(session, test_ds, eval_batch_size)
     return val
 
 

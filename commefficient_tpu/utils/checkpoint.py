@@ -128,7 +128,17 @@ class FedCheckpointer:
 
     def restore(self, session, step: Optional[int] = None) -> Optional[int]:
         """Restore into ``session`` in place; returns the restored round
-        index (== FedState.step) or None if nothing to restore."""
+        index (== FedState.step) or None if nothing to restore.
+
+        Checkpoints written before the compress/ registry (PR 2) lack the
+        ``comp`` FedState leaf and StandardRestore raises 'Dict key
+        mismatch' on any template/saved key difference; restore then
+        retries with the pre-PR2 template and keeps the session's freshly
+        initialized leaf (legacy modes: ()), so old checkpoints stay
+        restorable. (The mismatch is detected from the exception because
+        ``item_metadata`` returns None on a freshly opened manager — no
+        handler registry yet — so a pre-restore structure probe is not
+        available.)"""
         if not self.enabled:
             return None
         step = step if step is not None else self.mngr.latest_step()
@@ -136,10 +146,24 @@ class FedCheckpointer:
             return None
         import orbax.checkpoint as ocp
 
+        template = _to_saveable(session)
         try:
-            restored = self.mngr.restore(
-                step, args=ocp.args.StandardRestore(_to_saveable(session))
-            )
+            try:
+                restored = self.mngr.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+            except ValueError as e:
+                if not (
+                    "comp" in template["fed_state"]
+                    and "Dict key mismatch" in str(e)
+                    and "comp" in str(e)
+                ):
+                    raise
+                # pre-PR2 checkpoint: retry with the 6-leaf template
+                template["fed_state"].pop("comp")
+                restored = self.mngr.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
         except Exception as e:  # noqa: BLE001 — re-raise with provenance
             if session.spec is not None and self._saved_lacks_sketch_layout(
                 step, e
@@ -193,15 +217,32 @@ class FedCheckpointer:
             shardings = fsdp_state_shardings(session.cfg, session.mesh)
         else:
             shardings = FedState(*[session._replicated] * len(FedState._fields))
-        session.state = FedState(
-            **{
-                f: (() if isinstance(fs[f], (tuple, list)) and len(fs[f]) == 0
-                    else jax.device_put(
-                        jax.numpy.asarray(fs[f]), getattr(shardings, f)
-                    ))
-                for f in FedState._fields
-            }
-        )
+        leaves = {}
+        for f in FedState._fields:
+            if f not in fs:
+                # pre-PR2 checkpoint: no compressor warm state on disk —
+                # keep the session's freshly initialized leaf (legacy
+                # modes: (); a powersgd session restores everything else
+                # and restarts its Q warm-up cold).
+                leaves[f] = getattr(session.state, f)
+                if not isinstance(leaves[f], tuple):
+                    import warnings
+
+                    warnings.warn(
+                        f"checkpoint at step {step} predates the "
+                        f"compressor warm-state leaf {f!r}; restored "
+                        "everything else and re-initialized it (powersgd "
+                        "warm start restarts cold — one extra power "
+                        "iteration of subspace tracking)."
+                    )
+                continue
+            leaves[f] = (
+                () if isinstance(fs[f], (tuple, list)) and len(fs[f]) == 0
+                else jax.device_put(
+                    jax.numpy.asarray(fs[f]), getattr(shardings, f)
+                )
+            )
+        session.state = FedState(**leaves)
         if "host_vel" in restored:
             session.host_vel = np.asarray(restored["host_vel"])
         if "host_err" in restored:

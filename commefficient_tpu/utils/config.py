@@ -15,7 +15,10 @@ import dataclasses
 from dataclasses import dataclass
 from typing import Optional
 
-MODES = ("uncompressed", "sketch", "true_topk", "local_topk", "fedavg")
+# mirrors the compress/ registry (compress.available_modes); the two are
+# pinned equal by tests/test_mode_dispatch.py
+MODES = ("uncompressed", "sketch", "true_topk", "local_topk", "fedavg",
+         "powersgd")
 ERROR_TYPES = ("none", "local", "virtual")
 
 
@@ -37,6 +40,16 @@ class Config:
     # the exact-gather path (slower; reference --num_blocks memory trade)
     num_blocks: int = 1
     do_topk_down: bool = False  # top-k compress the downlink too
+
+    # --- powersgd (compress/powersgd.py; PowerSGD, arXiv:1905.13727) ---
+    # rank r of the warm-started power-iteration approximation; the flat
+    # [D] update is matricized near-square [n, m] (n ~ m ~ sqrt(D)), so the
+    # factored downlink is r*(n+m) floats — compression ~ sqrt(D)/(2r).
+    powersgd_rank: int = 4
+    # carry Q = M^T P_hat across rounds in FedState (the paper's warm
+    # start — one power iteration per round then tracks the top subspace);
+    # False resamples a fresh Gaussian Q from (seed, step) each round.
+    powersgd_warm_start: bool = True
 
     # --- momentum / error feedback (reference: --virtual_momentum,
     # --local_momentum, --error_type) ---
@@ -101,6 +114,16 @@ class Config:
     # per-class texture patches + label noise; ResNet-9 gradients
     # concentrate like real CIFAR's — see scripts/grad_probe.py).
     synthetic_variant: str = "flat"
+    # Label-noise fraction for the synthetic FEMNIST stand-in
+    # (data/emnist.py): that fraction of each client's samples is relabeled
+    # uniformly within the client's OWN class subset (non-IID structure
+    # preserved), bounding the accuracy ceiling below 1.0 (see
+    # _synthetic_femnist's ceiling math). Default 0.06 is the r5 value;
+    # exposed so the pre-r5 (r4) noise-free stand-in is reconstructible for
+    # audit with --label_noise 0 (ADVICE.md round-5 item). Ignored when
+    # real LEAF data is on disk, and by the CIFAR synthetic (which has its
+    # own fixed recipe).
+    label_noise: float = 0.06
     # None (default): derived from dataset_name (cifar10->10, cifar100->100,
     # femnist->62, imagenet->1000) — guards against silently training a
     # 10-class head on ImageNet (VERDICT r1 weak 6).
@@ -236,6 +259,29 @@ class Config:
                 "False, or set allow_unstable_sketch_dampening=True for "
                 "parity experiments."
             )
+        if self.mode == "powersgd":
+            if self.powersgd_rank < 1:
+                raise ValueError(
+                    f"powersgd_rank must be >= 1, got {self.powersgd_rank}"
+                )
+            if self.do_topk_down:
+                raise ValueError(
+                    "do_topk_down with mode='powersgd' is contradictory: "
+                    "the downlink is already the factored rank-r pair "
+                    "(r*(n+m) floats); top-k'ing the reconstructed delta "
+                    "would only un-compress it. Drop one of the two flags."
+                )
+            if self.momentum_dampening is True:
+                raise ValueError(
+                    "momentum_dampening is undefined for mode='powersgd': "
+                    "dampening zeroes momentum at EXTRACTED COORDINATES, "
+                    "and a rank-r subspace update has no coordinate "
+                    "selection to mask. Use momentum_dampening=None/False."
+                )
+        if self.label_noise < 0.0 or self.label_noise > 1.0:
+            raise ValueError(
+                f"label_noise must be in [0, 1], got {self.label_noise}"
+            )
         if self.error_decay != 1.0 and self.error_type != "virtual":
             raise ValueError(
                 "error_decay only acts on the server-side virtual error "
@@ -286,12 +332,20 @@ class Config:
 
     @property
     def sampler_batch_size(self) -> int:
-        """Samples the sampler draws per client per round. THE fedavg
-        convention, kept in one place: a fedavg round batch carries
-        ``num_local_iters`` microbatches of ``local_batch_size`` each."""
-        return self.local_batch_size * (
-            self.num_local_iters if self.mode == "fedavg" else 1
-        )
+        """Samples the sampler draws per client per round: a fedavg round
+        batch carries ``round_microbatches`` microbatches of
+        ``local_batch_size`` each (derived from that property so the
+        fedavg convention stays defined in exactly one place)."""
+        return self.local_batch_size * (self.round_microbatches or 1)
+
+    @property
+    def round_microbatches(self) -> int:
+        """Microbatches per client per round: ``num_local_iters`` for
+        fedavg's [W, L, B/L, ...] batch convention, else 0 (flat [W, B]
+        batches). THE mode-derived reshape knob, kept here so train loops
+        and the index-round path never branch on mode strings
+        (scripts/check_mode_dispatch.py)."""
+        return self.num_local_iters if self.mode == "fedavg" else 0
 
     @property
     def resolved_num_classes(self) -> int:

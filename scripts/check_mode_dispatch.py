@@ -1,0 +1,110 @@
+"""Lint: compression-mode dispatch must not leak out of compress/.
+
+The compress/ registry refactor (PR 2) moved every mode's algebra behind
+``compress.get_compressor``; the invariant that keeps a new compressor a
+one-file PR is that NOBODY else branches on mode strings. This script
+walks the ``commefficient_tpu`` package ASTs and fails on any
+
+  * comparison involving a ``mode`` name/attribute
+    (``cfg.mode == "sketch"``, ``mode != 'fedavg'``, ``cfg.mode in (...)``),
+  * dict/registry subscript keyed by a ``mode`` expression
+    (``{...}[cfg.mode]``),
+  * ``match cfg.mode:`` statement,
+
+outside the allowlist: ``compress/`` (the registry owns mode dispatch) and
+``utils/config.py`` (CLI validation + mode-derived conveniences like
+``round_microbatches`` live with the flag definitions). AST-based so
+docstrings/comments that merely MENTION modes never false-positive.
+
+Scope is the library package only: tests, bench.py, and scripts are
+harnesses that parametrize over modes by construction. Wired into tier-1
+via tests/test_mode_dispatch.py.
+
+    python scripts/check_mode_dispatch.py        # exit 1 on violations
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "commefficient_tpu"
+
+# paths (relative to the package root) where mode dispatch is LEGAL
+ALLOWED = ("compress/", "utils/config.py")
+
+
+def _is_modeish(node: ast.AST) -> bool:
+    """True for expressions naming the mode: ``mode``, ``*.mode``."""
+    if isinstance(node, ast.Name) and node.id == "mode":
+        return True
+    if isinstance(node, ast.Attribute) and node.attr == "mode":
+        return True
+    return False
+
+
+def scan_file(path: Path) -> list:
+    """[(lineno, snippet)] of mode-dispatch violations in one file."""
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:  # a broken file is its own CI problem
+        return [(e.lineno or 0, f"unparseable: {e.msg}")]
+    lines = src.splitlines()
+    out = []
+
+    def hit(node):
+        ln = getattr(node, "lineno", 0)
+        snippet = lines[ln - 1].strip() if 0 < ln <= len(lines) else ""
+        out.append((ln, snippet))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare):
+            if _is_modeish(node.left) or any(
+                _is_modeish(c) for c in node.comparators
+            ):
+                hit(node)
+        elif isinstance(node, ast.Subscript):
+            if _is_modeish(node.slice):
+                hit(node)
+        elif isinstance(node, ast.Match):
+            if _is_modeish(node.subject):
+                hit(node)
+    return out
+
+
+def scan_package(package_root: Path = PACKAGE) -> dict:
+    """{relative_path: [(lineno, snippet)]} over the package, allowlist
+    applied."""
+    violations = {}
+    for path in sorted(package_root.rglob("*.py")):
+        rel = path.relative_to(package_root).as_posix()
+        if any(rel == a or rel.startswith(a) for a in ALLOWED):
+            continue
+        hits = scan_file(path)
+        if hits:
+            violations[rel] = hits
+    return violations
+
+
+def main() -> int:
+    violations = scan_package()
+    for rel, hits in violations.items():
+        for ln, snippet in hits:
+            print(f"commefficient_tpu/{rel}:{ln}: mode-string dispatch "
+                  f"outside compress/: {snippet}")
+    if violations:
+        n = sum(len(h) for h in violations.values())
+        print(f"\n{n} violation(s). Mode dispatch belongs in "
+              "commefficient_tpu/compress/ (the registry) or "
+              "utils/config.py (flag validation/conveniences); route "
+              "other layers through compress.get_compressor / Config "
+              "properties.")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,13 @@ def main():
         help="backend for the full-round ground-truth section; the "
         "per-phase sketch/unsketch breakdown always times BOTH backends",
     )
+    ap.add_argument(
+        "--mode", default="sketch", choices=("sketch", "powersgd"),
+        help="compressor for the full-round ground-truth section (the "
+        "sketch phase breakdown always runs; powersgd adds its own "
+        "matricize/GS/reconstruct phase lines)",
+    )
+    ap.add_argument("--powersgd_rank", type=int, default=4)
     args = ap.parse_args()
 
     from commefficient_tpu.models import ResNet9, classification_loss
@@ -156,18 +163,52 @@ def main():
               f" -> {workers * batch / total * 1e3:,.0f} samples/s "
               f"(bench does {workers * batch}/round)")
 
+    # -- powersgd phase split (PR 2: compress/powersgd.py) -----------------
+    # the server-side cost the mode adds per round: matricize + P = M Q +
+    # Gram-Schmidt + Q_new = M^T P_hat + rank-r reconstruct — all MXU work
+    from commefficient_tpu.compress.powersgd import gram_schmidt, matrix_shape
+
+    n_rows_m, m_cols_m = matrix_shape(d)
+    rank = args.powersgd_rank
+    q0 = jnp.asarray(rng.normal(size=(m_cols_m, rank)).astype(np.float32))
+
+    @jax.jit
+    def powersgd_approx(vec, Q):
+        M = jnp.pad(vec, (0, n_rows_m * m_cols_m - d)).reshape(
+            n_rows_m, m_cols_m)
+        P_hat = gram_schmidt(M @ Q)
+        Q_new = M.T @ P_hat
+        return (P_hat @ Q_new.T).reshape(-1)[:d], Q_new
+
+    gs_j = jax.jit(gram_schmidt)
+    p0 = jnp.asarray(rng.normal(size=(n_rows_m, rank)).astype(np.float32))
+    timeit(f"[powersgd] GS orthonormalize [n={n_rows_m}, r={rank}]",
+           gs_j, p0, reps=r)
+    t_psgd = timeit(
+        f"[powersgd] full approx (matricize+P+GS+Q+reconstruct) r={rank}",
+        powersgd_approx, v, q0, reps=r)
+    total = t_modelw + t_psgd
+    print(f"[powersgd] round ≈ model {t_modelw:.1f} + approx {t_psgd:.1f} "
+          f"= {total:.1f} ms -> {workers * batch / total * 1e3:,.0f} "
+          f"samples/s")
+
     # ground truth: the EXACT bench config (bench.py r2: fuse_clients,
     # batch 256, num_blocks 1) so this number reconciles against bench.py
     from commefficient_tpu.parallel import FederatedSession, make_mesh
     from commefficient_tpu.utils.config import Config
 
     bench_batch = batch  # == the bench r2 shape profiled above
-    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
-                 k=k, num_rows=5, num_cols=500_000, num_blocks=1,
-                 topk_method="threshold", fuse_clients=True,
-                 num_clients=2 * workers, num_workers=workers, num_devices=1,
-                 local_batch_size=bench_batch, weight_decay=5e-4,
-                 sketch_backend=args.sketch_backend)
+    common = dict(error_type="virtual", virtual_momentum=0.9,
+                  topk_method="threshold", fuse_clients=True,
+                  num_clients=2 * workers, num_workers=workers,
+                  num_devices=1, local_batch_size=bench_batch,
+                  weight_decay=5e-4)
+    if args.mode == "powersgd":
+        cfg = Config(mode="powersgd", powersgd_rank=rank, **common)
+    else:
+        cfg = Config(mode="sketch", k=k, num_rows=5, num_cols=500_000,
+                     num_blocks=1, sketch_backend=args.sketch_backend,
+                     **common)
     session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
     ids = jnp.arange(workers, dtype=jnp.int32)
     data = {"x": jnp.asarray(rng.normal(
@@ -190,7 +231,8 @@ def main():
     state, losses = run_rounds(state)
     fence(losses)
     dt = (time.perf_counter() - t0) / n * 1e3
-    print(f"scanned full round [{args.sketch_backend}]: {dt:.2f} ms -> "
+    tag = args.mode if args.mode != "sketch" else args.sketch_backend
+    print(f"scanned full round [{tag}]: {dt:.2f} ms -> "
           f"{workers * bench_batch / dt * 1e3:,.0f} samples/s")
 
 

@@ -25,6 +25,10 @@ def _train(sess, sampler, cfg, start, stop, ckpt=None):
                     num_rows=3, num_cols=512)),
     ("local_topk", dict(error_type="local", local_momentum=0.9, k=30)),
     ("local_topk", dict(error_type="local", k=30, offload_client_state=True)),
+    # powersgd: the warm-start Q rides in FedState.comp and must survive
+    # the kill/restore for the resumed run to be bit-for-bit (PR 2)
+    ("powersgd", dict(error_type="virtual", virtual_momentum=0.9,
+                      powersgd_rank=2)),
 ])
 def test_kill_and_resume_reproduces_uninterrupted_run(tmp_path, mode, extra):
     cfg = Config(mode=mode, **extra, **BASE)
@@ -135,3 +139,48 @@ def test_restore_refuses_mismatched_sketch_layout(tmp_path):
     ckpt3 = FedCheckpointer(cfg)
     assert ckpt3.restore(sess3) == 2
     ckpt3.close()
+
+
+def test_restore_accepts_pre_comp_checkpoint(tmp_path):
+    """Checkpoints written BEFORE the compress/ registry (PR 2) have a
+    6-leaf fed_state (no ``comp``); StandardRestore raises 'Dict key
+    mismatch' on any template/saved key difference, so restore must adapt
+    its template instead of stranding every old checkpoint."""
+    import orbax.checkpoint as ocp
+
+    from commefficient_tpu.utils.checkpoint import _to_saveable
+
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=40, num_rows=3, num_cols=512,
+                 checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+                 **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    samp = FedSampler(ds, num_workers=cfg.num_workers,
+                      local_batch_size=cfg.local_batch_size, seed=1)
+    _train(sess, samp, cfg, 0, 2)
+
+    # write a LEGACY-format checkpoint: today's state, pre-PR2 key set
+    blob = _to_saveable(sess)
+    assert blob["fed_state"].pop("comp") == ()
+    import os
+
+    mngr = ocp.CheckpointManager(
+        os.path.abspath(cfg.checkpoint_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3),
+    )
+    mngr.save(2, args=ocp.args.StandardSave(blob))
+    mngr.wait_until_finished()
+    mngr.close()
+
+    sess2 = FederatedSession(cfg, params, loss_fn)
+    ck = FedCheckpointer(cfg)
+    assert ck.restore(sess2) == 2
+    ck.close()
+    np.testing.assert_array_equal(
+        np.asarray(sess.state.params_vec), np.asarray(sess2.state.params_vec)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sess.state.error), np.asarray(sess2.state.error)
+    )
+    assert sess2.state.comp == ()

@@ -71,6 +71,53 @@ def test_sketch_dampening_gated():
     Config(mode="true_topk", momentum_dampening=True)
 
 
+def test_powersgd_flags_cli_roundtrip():
+    cfg = parse_args(
+        [
+            "--mode", "powersgd",
+            "--powersgd_rank", "7",
+            "--powersgd_warm_start", "false",
+            "--error_type", "virtual",
+            "--virtual_momentum", "0.9",
+        ]
+    )
+    assert cfg.mode == "powersgd"
+    assert cfg.powersgd_rank == 7
+    assert cfg.powersgd_warm_start is False
+    # defaults
+    cfg2 = parse_args(["--mode", "powersgd"])
+    assert cfg2.powersgd_rank == 4 and cfg2.powersgd_warm_start is True
+
+
+def test_powersgd_validation():
+    with pytest.raises(ValueError, match="powersgd_rank"):
+        Config(mode="powersgd", powersgd_rank=0)
+    with pytest.raises(ValueError, match="do_topk_down"):
+        Config(mode="powersgd", do_topk_down=True)
+    with pytest.raises(ValueError, match="dampening"):
+        Config(mode="powersgd", momentum_dampening=True)
+    # AUTO/False dampening fine; rank flags don't disturb other modes
+    Config(mode="powersgd", momentum_dampening=None)
+    Config(mode="sketch", powersgd_rank=9)
+
+
+def test_label_noise_cli_and_validation():
+    assert parse_args(["--label_noise", "0.0"]).label_noise == 0.0
+    assert parse_args(["--label_noise", "0.25"]).label_noise == 0.25
+    with pytest.raises(ValueError, match="label_noise"):
+        Config(label_noise=1.5)
+    with pytest.raises(ValueError, match="label_noise"):
+        Config(label_noise=-0.1)
+
+
+def test_round_microbatches_property():
+    # the mode-derived reshape knob train loops use instead of branching on
+    # mode strings (scripts/check_mode_dispatch.py boundary)
+    assert Config(mode="fedavg", num_local_iters=4).round_microbatches == 4
+    assert Config(mode="uncompressed").round_microbatches == 0
+    assert Config(mode="powersgd", num_local_iters=4).round_microbatches == 0
+
+
 def test_piecewise_linear_shape():
     kw = dict(steps_per_epoch=10, pivot_epoch=5, num_epochs=20, lr_scale=0.4)
     lrs = np.array(

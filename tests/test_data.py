@@ -94,6 +94,56 @@ def test_femnist_natural_clients(tmp_path):
         assert len(np.unique(tr.data["y"][ix])) <= 15
 
 
+def test_femnist_label_noise_reconstructible(tmp_path):
+    """label_noise now reaches the synthetic stand-in through Config/CLI
+    (ADVICE r5 on data/emnist.py): --label_noise 0 reconstructs the pre-r5
+    (r4) noise-free distribution exactly; the default 0.06 flips ~6% of
+    labels WITHIN each client's class subset (inputs untouched)."""
+    clean_tr, clean_te, _ = load_fed_emnist(
+        str(tmp_path), num_clients=10, label_noise=0.0
+    )
+    noisy_tr, noisy_te, _ = load_fed_emnist(
+        str(tmp_path), num_clients=10, label_noise=0.3
+    )
+    default_tr, _, _ = load_fed_emnist(str(tmp_path), num_clients=10)
+    # inputs are bit-identical across noise settings — only labels move
+    np.testing.assert_array_equal(clean_tr.data["x"], noisy_tr.data["x"])
+    flipped = np.mean(clean_tr.data["y"] != noisy_tr.data["y"])
+    # relabels draw uniformly from the client's OWN subset, so a ~1/|C|
+    # fraction of flips lands back on the true class: observed rate is
+    # p*(1 - E[1/|C|]) ~ 0.3 * 0.885
+    assert 0.18 < flipped < 0.3
+    # the noise stays inside each client's class subset (non-IID structure
+    # — the thing FEMNIST exists to test — is preserved)
+    for ix in noisy_tr.client_indices:
+        assert set(np.unique(noisy_tr.data["y"][ix])) <= set(
+            np.unique(clean_tr.data["y"][ix])
+        )
+    # the default (0.06) is noisy: r4 reconstruction REQUIRES passing 0
+    assert np.any(default_tr.data["y"] != clean_tr.data["y"])
+
+    # BIT-EXACT r4 reconstruction: label_noise=0 must reproduce the
+    # pre-r5 generator's draw sequence (this inline oracle is the r4
+    # algorithm verbatim — commit ebb267a's _synthetic_femnist)
+    rng = np.random.default_rng(42)  # load_fed_emnist's default seed
+    protos = rng.normal(0, 1, size=(62, 28, 28, 1)).astype(np.float32)
+    xs, ys = [], []
+    for _ in range(10):
+        style = rng.normal(0, 0.5, size=(28, 28, 1)).astype(np.float32)
+        classes = rng.choice(62, size=rng.integers(5, 15), replace=False)
+        y = rng.choice(classes, size=120).astype(np.int32)
+        x = protos[y] + style + rng.normal(
+            0, 0.3, size=(120, 28, 28, 1)
+        ).astype(np.float32)
+        xs.append(x.astype(np.float32))
+        ys.append(y)
+    r4_x, r4_y = np.concatenate(xs), np.concatenate(ys)
+    # the train FedDataset holds the FULL generated arrays (client_indices
+    # carve the train/test views), so the comparison is direct + bit-exact
+    np.testing.assert_array_equal(clean_tr.data["y"], r4_y)
+    np.testing.assert_array_equal(clean_tr.data["x"], r4_x)
+
+
 def test_personachat_assembly_contract(tmp_path):
     tr, te, real, vocab = load_fed_personachat(
         str(tmp_path), num_clients=6, num_candidates=2, max_seq_len=64

@@ -1,0 +1,91 @@
+"""The compress/ subsystem boundary, enforced in tier-1.
+
+Two invariants: (1) no mode-string dispatch outside compress/ +
+utils/config.py (scripts/check_mode_dispatch.py, so the registry boundary
+can't silently erode), and (2) the registry and the CLI's MODES tuple stay
+in sync (a registered-but-unlisted mode would be unreachable from the CLI;
+a listed-but-unregistered one would crash at session build)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint():
+    spec = importlib.util.spec_from_file_location(
+        "check_mode_dispatch",
+        os.path.join(REPO, "scripts", "check_mode_dispatch.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_no_mode_dispatch_outside_compress():
+    lint = _lint()
+    violations = lint.scan_package()
+    assert not violations, (
+        "mode-string dispatch leaked outside compress/ + utils/config.py:\n"
+        + "\n".join(
+            f"  commefficient_tpu/{rel}:{ln}: {snip}"
+            for rel, hits in violations.items()
+            for ln, snip in hits
+        )
+    )
+
+
+def test_lint_actually_detects_violations(tmp_path):
+    """The lint must FLAG the patterns it claims to (guards against the
+    checker rotting into a vacuous pass)."""
+    lint = _lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(cfg, mode):\n"
+        "    if cfg.mode == 'sketch':\n"
+        "        pass\n"
+        "    if mode in ('fedavg', 'local_topk'):\n"
+        "        pass\n"
+        "    x = {'a': 1}[cfg.mode]\n"
+        "    # a comment saying cfg.mode == 'sketch' must NOT count\n"
+        "    s = \"docstrings mentioning mode == 'sketch' neither\"\n"
+    )
+    hits = lint.scan_file(bad)
+    assert [ln for ln, _ in hits] == [2, 4, 6]
+
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        "def g(cfg, comp):\n"
+        "    if comp.dense_delta and cfg.do_topk_down:\n"
+        "        pass\n"
+        "    return cfg.mode  # reading (not branching on) mode is fine\n"
+    )
+    assert lint.scan_file(clean) == []
+
+
+def test_lint_allowlists_compress_and_config():
+    lint = _lint()
+    pkg = os.path.join(REPO, "commefficient_tpu")
+    # the allowed homes really do contain dispatch (sanity: the allowlist
+    # is load-bearing, not decorative)
+    reg = lint.scan_file(
+        __import__("pathlib").Path(pkg, "utils", "config.py")
+    )
+    assert reg, "utils/config.py is expected to branch on mode (validation)"
+
+
+def test_registry_matches_config_modes():
+    from commefficient_tpu.compress import available_modes
+    from commefficient_tpu.utils.config import MODES
+
+    assert set(available_modes()) == set(MODES)
+
+
+def test_unknown_mode_rejected_with_registered_list():
+    from commefficient_tpu.compress import compressor_class
+
+    with pytest.raises(ValueError, match="registered"):
+        compressor_class("bogus")

@@ -1,0 +1,170 @@
+"""PowerSGD compressor tests (compress/powersgd.py).
+
+The oracle contract: at full rank the Gram-Schmidt power iteration
+reconstructs the matricized accumulator EXACTLY (P_hat spans range(M)), so
+mode=powersgd must reduce to the uncompressed round; at low rank it must
+train under lr-scaled error feedback with the same Alg-1 banking semantics
+as the other modes (varying-lr regression)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from test_round import BASE, _final_vec, _ignore_batch_like, _run, _setup
+
+from commefficient_tpu.compress.powersgd import gram_schmidt, matrix_shape
+from commefficient_tpu.data import FedSampler
+from commefficient_tpu.ops import ravel_params
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils.config import Config
+
+
+def _full_rank():
+    ds, params, loss_fn = _setup()
+    d = int(ravel_params(params)[0].size)
+    n, m = matrix_shape(d)
+    return min(n, m), d
+
+
+def test_gram_schmidt_orthonormalizes():
+    rng = np.random.default_rng(0)
+    P = jnp.asarray(rng.normal(size=(40, 6)).astype(np.float32))
+    Q = np.asarray(gram_schmidt(P))
+    np.testing.assert_allclose(Q.T @ Q, np.eye(6), atol=1e-5)
+    # spans the same subspace: projecting P onto Q reproduces P
+    np.testing.assert_allclose(Q @ (Q.T @ np.asarray(P)), np.asarray(P),
+                               atol=1e-4)
+
+
+def test_gram_schmidt_rank_deficient_collapses_to_zero():
+    """Dependent columns must become exact zeros, not amplified noise."""
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(40, 1)).astype(np.float32)
+    P = jnp.asarray(np.concatenate([a, 2.0 * a, a + 1e-9], axis=1))
+    Q = np.asarray(gram_schmidt(P))
+    assert np.abs(Q[:, 1]).max() < 1e-5
+    np.testing.assert_allclose(np.linalg.norm(Q[:, 0]), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_full_rank_with_error_feedback_equals_uncompressed(momentum):
+    """Rank-sweep oracle, top end: r = min(n, m) reconstructs exactly, so
+    the error bank stays zero and the round IS the uncompressed round."""
+    rank, _ = _full_rank()
+    cfg_p = Config(mode="powersgd", error_type="virtual",
+                   powersgd_rank=rank, virtual_momentum=momentum, **BASE)
+    cfg_u = Config(mode="uncompressed", virtual_momentum=momentum, **BASE)
+    sp, lp = _run(cfg_p)
+    su, lu = _run(cfg_u)
+    np.testing.assert_allclose(lp, lu, rtol=1e-4)
+    # exact in exact arithmetic; fp32 GS rounding compounds over 5 rounds
+    np.testing.assert_allclose(_final_vec(sp), _final_vec(su), atol=5e-4)
+
+
+def test_full_rank_no_error_equals_uncompressed():
+    rank, _ = _full_rank()
+    cfg_p = Config(mode="powersgd", error_type="none", powersgd_rank=rank,
+                   virtual_momentum=0.9, **BASE)
+    cfg_u = Config(mode="uncompressed", virtual_momentum=0.9, **BASE)
+    sp, _ = _run(cfg_p)
+    su, _ = _run(cfg_u)
+    # fp32 GS rounding headroom, as above
+    np.testing.assert_allclose(_final_vec(sp), _final_vec(su), atol=5e-4)
+
+
+@pytest.mark.parametrize("rank", [1, 4])
+def test_low_rank_trains_with_error_feedback(rank):
+    """Rank-sweep oracle, low end: heavy compression still converges under
+    error feedback (the PowerSGD paper's core claim)."""
+    cfg = Config(mode="powersgd", error_type="virtual", powersgd_rank=rank,
+                 virtual_momentum=0.9, **BASE)
+    _, losses = _run(cfg, n_rounds=15)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_error_feedback_banks_lr_at_accumulation_powersgd():
+    """Same Alg-1 contract as sketch/true_topk (round.py docstring
+    DECISION): residual banked at round-1's lr applies at THAT lr — a
+    zero-gradient round 2 must be lr2-invariant."""
+    cfg = Config(mode="powersgd", error_type="virtual", powersgd_rank=2,
+                 **BASE)
+    finals = []
+    for lr2 in (0.01, 1.0):
+        ds, params, loss_fn = _setup(cfg.num_clients)
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        ids, batch = sampler.sample_round(0)
+        sess.train_round(ids, batch, lr=0.3)
+        sess.train_round(ids, _ignore_batch_like(batch), lr=lr2)
+        finals.append(_final_vec(sess))
+    np.testing.assert_allclose(finals[0], finals[1], atol=1e-6)
+
+
+def test_warm_start_carries_q_in_fedstate():
+    cfg = Config(mode="powersgd", error_type="virtual", powersgd_rank=3,
+                 powersgd_warm_start=True, **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    q0 = np.asarray(sess.state.comp).copy()
+    d = int(ravel_params(params)[0].size)
+    n, m = matrix_shape(d)
+    assert q0.shape == (m, 3)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    ids, batch = sampler.sample_round(0)
+    sess.train_round(ids, batch, 0.2)
+    q1 = np.asarray(sess.state.comp)
+    assert np.abs(q1 - q0).max() > 1e-6  # the power iteration moved Q
+
+    # warm_start=False carries NO state at all: Q is resampled from
+    # (seed, step) each round, so FedState/checkpoints hold ()
+    cfg2 = cfg.replace(powersgd_warm_start=False)
+    sess2 = FederatedSession(cfg2, params, loss_fn)
+    assert sess2.state.comp == ()
+    sess2.train_round(ids, batch, 0.2)
+    assert sess2.state.comp == ()
+    assert np.isfinite(_final_vec(sess2)).all()
+
+
+def test_warm_start_changes_trajectory_at_low_rank():
+    kw = dict(mode="powersgd", error_type="virtual", powersgd_rank=1,
+              virtual_momentum=0.9)
+    s_warm, _ = _run(Config(powersgd_warm_start=True, **kw, **BASE))
+    s_cold, _ = _run(Config(powersgd_warm_start=False, **kw, **BASE))
+    assert np.abs(_final_vec(s_warm) - _final_vec(s_cold)).max() > 0
+
+
+def test_bytes_per_round_reports_factored_downlink():
+    cfg = Config(mode="powersgd", error_type="virtual", powersgd_rank=2,
+                 **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    d = sess.grad_size
+    n, m = matrix_shape(d)
+    bpr = sess.bytes_per_round()
+    assert bpr["upload_floats"] == d  # server-side compression, like true_topk
+    assert bpr["download_floats"] == 2 * (n + m)
+    assert bpr["download_bytes"] == 4 * 2 * (n + m)
+
+
+def test_powersgd_rejects_unsupported_combinations():
+    with pytest.raises(ValueError, match="do_topk_down"):
+        Config(mode="powersgd", do_topk_down=True, **BASE)
+    with pytest.raises(ValueError, match="dampening"):
+        Config(mode="powersgd", momentum_dampening=True, **BASE)
+    with pytest.raises(ValueError, match="powersgd_rank"):
+        Config(mode="powersgd", powersgd_rank=0, **BASE)
+    ds, params, loss_fn = _setup()
+    with pytest.raises(NotImplementedError):
+        FederatedSession(
+            Config(mode="powersgd", error_type="local", **BASE),
+            params, loss_fn,
+        )
+    with pytest.raises(NotImplementedError, match="fsdp"):
+        FederatedSession(
+            Config(mode="powersgd", topk_method="threshold", fsdp=True,
+                   **BASE),
+            params, loss_fn,
+        )

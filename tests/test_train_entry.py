@@ -32,6 +32,34 @@ def test_cv_train_femnist_end_to_end(tmp_path):
     assert 0.0 <= val["accuracy"] <= 1.0
 
 
+def test_cv_train_powersgd_end_to_end(tmp_path):
+    """PR 2 acceptance: mode=powersgd trains end-to-end through the real
+    cv_train entry (CLI flags -> Config -> compress/ registry -> round),
+    warm-started rank-2 with virtual error feedback, on the femnist
+    stand-in (the cheapest real dataset path on the 1-core CPU budget)."""
+    val = cv_main(
+        [],
+        dataset_name="femnist",
+        model="resnet9",
+        mode="powersgd",
+        error_type="virtual",
+        virtual_momentum=0.9,
+        powersgd_rank=2,
+        num_clients=6,
+        num_workers=4,
+        num_devices=4,
+        local_batch_size=32,
+        num_epochs=1,
+        pivot_epoch=1,
+        lr_scale=0.1,
+        dataset_dir=str(tmp_path),
+        logdir=str(tmp_path / "runs"),
+        seed=0,
+    )
+    assert np.isfinite(val["loss"])
+    assert 0.0 <= val["accuracy"] <= 1.0
+
+
 @pytest.mark.slow  # same path as test_cv_train_takes_device_data_path_e2e
 # (femnist, uncompressed, cv_main) which stays in the default tier
 def test_cv_train_uncompressed_single_worker(tmp_path):
