@@ -296,6 +296,11 @@ def main():
             # unsketch extract)
             "powersgd_r4_fused": base.replace(mode="powersgd",
                                               powersgd_rank=4),
+            # PR 3 telemetry: the level-2 in-graph diagnostics (norms +
+            # sentinel + sketch round-trip fidelity) riding the headline
+            # round — tracks the observability tax against the level-0
+            # headline (which is bit-identical to pre-telemetry rounds)
+            "sketch_telemetry_l2": base.replace(telemetry_level=2),
         }
         for name, cfg in matrix.items():
             sps = _measure(cfg)

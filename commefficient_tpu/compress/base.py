@@ -174,6 +174,56 @@ class Compressor:
         ``supports_fsdp`` implement it."""
         raise NotImplementedError
 
+    # ---- telemetry (telemetry/diagnostics.py round hook) -----------------
+    def diagnostics(self, level: int, *, agg, delta, momentum, error, extra,
+                    new_error, lr) -> dict:
+        """In-graph diagnostic scalars for one round, keyed WITHOUT the
+        ``diag/`` prefix (``telemetry.round_diagnostics`` adds it). Runs
+        under jit like every other hook; called by the round builders only
+        at ``cfg.telemetry_level >= 1``, so level 0 traces nothing.
+
+        ``agg`` is the psum-averaged aggregate in this mode's encoded
+        domain (dense [D] for dense-transmit modes, the [r, c] table for
+        sketch); ``momentum``/``error``/``extra`` are the PRE-update
+        FedState leaves (what ``server_update`` consumed — ``fidelity``
+        recomputes from them, XLA CSEs the overlap); ``new_error`` the
+        post-extract bank; ``delta`` the applied update (always dense [D]
+        in the replicated round). Subclasses override the ``_agg_sqnorm``/
+        ``_error_sqnorm`` primitives (sketch: AMS table estimates) and
+        ``fidelity`` (level >= 2), not this driver."""
+        d = {
+            "grad_norm": jnp.sqrt(self._agg_sqnorm(agg)),
+            "update_norm": jnp.sqrt(jnp.sum(jnp.square(delta))),
+        }
+        ef = self._error_sqnorm(new_error)
+        if ef is not None:
+            # single server bank: mean == max (local-error modes report
+            # per-participant rows via round_diagnostics instead)
+            d["ef_residual_norm"] = jnp.sqrt(ef)
+            d["ef_residual_max"] = d["ef_residual_norm"]
+        if level >= 2:
+            d.update(self.fidelity(agg=agg, delta=delta, momentum=momentum,
+                                   error=error, extra=extra, lr=lr))
+        return d
+
+    def _agg_sqnorm(self, agg):
+        """Squared L2 norm of the decoded transmitted aggregate; the base
+        aggregate is already dense."""
+        return jnp.sum(jnp.square(agg))
+
+    def _error_sqnorm(self, error):
+        """Squared norm of the server error bank, or None when this mode
+        keeps no server-side bank (() leaf / local error)."""
+        if isinstance(error, tuple):
+            return None
+        return jnp.sum(jnp.square(error))
+
+    def fidelity(self, *, agg, delta, momentum, error, extra, lr) -> dict:
+        """Level-2 compression-fidelity scalars (how well the extracted
+        update represents what it approximates); base modes are exact, so
+        nothing to report."""
+        return {}
+
     # ---- communication accounting (bytes_per_round) ----------------------
     def upload_floats(self) -> int:
         """Per-client uplink floats per round."""

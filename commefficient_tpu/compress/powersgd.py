@@ -174,6 +174,29 @@ class PowerSGDCompressor(Compressor):
         new_extra = q_new if cfg.powersgd_warm_start else extra
         return delta, m, e, new_extra
 
+    def fidelity(self, *, agg, delta, momentum, error, extra, lr) -> dict:
+        """Reconstruction residual ``||M - P_hat Q_new^T|| / ||M||`` of this
+        round's power iteration, where M is the matricized compression
+        input. The input is recomputed from the PRE-update leaves exactly
+        as ``server_update`` built it (XLA CSEs the overlap; no second
+        power iteration — ``delta`` IS the reconstruction): virtual-error
+        path compresses ``e + lr*m`` and applies it unscaled; the no-error
+        path compresses ``m`` and applies ``lr * approx(m)``, and the ratio
+        is scale-invariant, so comparing ``lr*m`` against ``delta`` gives
+        the same residual (0/tiny -> 0 at the schedule's exact-lr-0 final
+        round). Padding rows of M are zero in both M and the
+        reconstruction's error feedback view restricted to [:d], so the
+        vec-space norm equals the matrix residual on the real
+        coordinates. Vector ops only (level 2)."""
+        m = self.cfg.virtual_momentum * momentum + agg
+        if self.cfg.error_type == "virtual":
+            compressed_input = error + lr * m
+        else:
+            compressed_input = lr * m
+        num = jnp.sqrt(jnp.sum(jnp.square(compressed_input - delta)))
+        den = jnp.sqrt(jnp.sum(jnp.square(compressed_input)))
+        return {"powersgd_recon_rel_err": num / jnp.maximum(den, 1e-30)}
+
     def download_floats(self) -> int:
         # the applied delta is exactly representable as (P_hat, Q_new)
         return self.rank * (self.n + self.m)

@@ -19,6 +19,7 @@ from commefficient_tpu.ops.countsketch import (
     estimate_at,
     sketch_sparse,
     sketch_vec,
+    table_sqnorm_estimate,
 )
 from commefficient_tpu.ops.topk import topk_threshold_sharded
 
@@ -120,6 +121,36 @@ class SketchCompressor(Compressor):
             delta_sh = lr * topk_threshold_sharded(est, cfg.k, axis_name)
         new_m = m if rho > 0 else m_in
         return p_sh - delta_sh, new_m, e
+
+    # ---- telemetry -------------------------------------------------------
+    # the dense aggregate never exists in sketch mode (device_encode runs
+    # before the psum), so norm diagnostics use the AMS/CountSketch F2
+    # estimator on the tables (ops.countsketch.table_sqnorm_estimate) —
+    # free (no unsketch, no [D] transient), unbiased per row.
+    def _agg_sqnorm(self, agg):
+        return table_sqnorm_estimate(agg)
+
+    def _error_sqnorm(self, error):
+        if isinstance(error, tuple):
+            return None
+        return table_sqnorm_estimate(error)
+
+    def fidelity(self, *, agg, delta, momentum, error, extra, lr) -> dict:
+        """Round-trip estimation relative error at the extracted update's
+        own support: sketch ``delta`` into a fresh table, re-estimate it at
+        its nonzero coordinates, and report ``||est - delta|| / ||delta||``
+        over that support. This measures the table's collision noise at the
+        current k/c occupancy — the quantity the sketched-SGD analysis
+        (arXiv:1903.04488) bounds; at small d/c it tracks the estimation
+        error against the exact top-k the unsketch approximates (a huge
+        table drives it to ~0 — pinned by tests/test_telemetry.py). Cost:
+        one extra sketch + estimate pass per round (level 2 only)."""
+        spec = self.spec
+        rt = estimate_all(spec, sketch_vec(spec, delta))
+        mask = delta != 0
+        num = jnp.sqrt(jnp.sum(jnp.square(jnp.where(mask, rt - delta, 0.0))))
+        den = jnp.sqrt(jnp.sum(jnp.square(delta)))
+        return {"sketch_est_rel_err": num / jnp.maximum(den, 1e-30)}
 
     def upload_floats(self) -> int:
         """The REALIZED table size ``r * c_actual`` (the blocked layout

@@ -715,6 +715,16 @@ def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp
     return table + sketch_vec(spec, v)
 
 
+def table_sqnorm_estimate(table: jnp.ndarray) -> jnp.ndarray:
+    """AMS estimate of ``||v||^2`` from v's CountSketch table [r, c]: each
+    row's squared norm is an unbiased estimate of ``||v||^2`` (signs are
+    4-universal), and the median over rows tames collision outliers — the
+    classic AMS/CountSketch F2 estimator. Free relative to an unsketch: no
+    estimate pass, no [d] transient. Used by the telemetry diagnostics
+    (sketch-mode norm scalars, the replicated AND FSDP rounds)."""
+    return jnp.median(jnp.sum(jnp.square(table), axis=1))
+
+
 def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
     tab = _overlap_gather(spec, table_row, row)
     est = jnp.einsum(

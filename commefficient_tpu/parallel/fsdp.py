@@ -84,6 +84,7 @@ from commefficient_tpu.parallel.round import (
     make_grad_one,
     sum_client_grads,
 )
+from commefficient_tpu.telemetry import nonfinite_sentinel, table_sqnorm_estimate
 from commefficient_tpu.utils.config import Config
 from commefficient_tpu.utils.jax_compat import shard_map
 
@@ -251,7 +252,62 @@ def build_fsdp_round_fn(
             p_sh, m_in, e_in, local, lr,
             axis_name=WORKERS, W=W, d=d, dp=dp, S=S,
         )
-        return new_p, new_m, new_e, loss_mean, aux_sum
+
+        # ---- in-graph diagnostics (telemetry/): sharded realization ------
+        # Norms come from psum'd shard sq-norms, so no [D] array beyond the
+        # transients the round already pays. grad_norm matches the
+        # replicated round's per-mode semantics: sketch modes AMS-estimate
+        # from the psum'd table (the same sketch_vec + psum fsdp_update
+        # runs, so XLA CSEs it — no dense cross-chip reduction is added in
+        # the mode whose point is avoiding one); dense-transmit modes
+        # reduce-scatter the transmit sum into a [S] slice (CSEs against
+        # fsdp_update's own psum_scatter). Compressor fidelity (level 2) is
+        # a replicated-round-only diagnostic — the sharded extraction has
+        # no full estimate to compare against.
+        diag = {}
+        if cfg.telemetry_level >= 1:
+            with jax.named_scope("telemetry_diag"):
+                if comp.needs_sketch_spec:
+                    agg_table = jax.lax.psum(
+                        comp.device_encode(local), WORKERS
+                    ) / W
+                    grad_norm = jnp.sqrt(table_sqnorm_estimate(agg_table))
+                else:
+                    g_sh = jax.lax.psum_scatter(
+                        jnp.pad(local, (0, dp - d)), WORKERS,
+                        scatter_dimension=0, tiled=True,
+                    ) / W
+                    grad_norm = jnp.sqrt(jax.lax.psum(
+                        jnp.sum(jnp.square(g_sh)), WORKERS
+                    ))
+                delta_sh = p_sh - new_p
+                update_norm = jnp.sqrt(jax.lax.psum(
+                    jnp.sum(jnp.square(delta_sh)), WORKERS
+                ))
+                diag = {"diag/grad_norm": grad_norm,
+                        "diag/update_norm": update_norm}
+                if e_kind == KIND_DENSE:
+                    ef = jnp.sqrt(jax.lax.psum(
+                        jnp.sum(jnp.square(new_e)), WORKERS
+                    ))
+                elif e_kind == KIND_TABLE:
+                    ef = jnp.sqrt(table_sqnorm_estimate(new_e))
+                else:
+                    ef = None
+                if ef is not None:
+                    diag["diag/ef_residual_norm"] = ef
+                    diag["diag/ef_residual_max"] = ef
+                # shard-local param finiteness -> a cross-chip bad count
+                # (the count itself is finite, so it ORs into the sentinel
+                # explicitly rather than riding the isfinite checks)
+                bad_params = jax.lax.psum(
+                    1.0 - jnp.all(jnp.isfinite(new_p)).astype(f32), WORKERS
+                )
+                s = nonfinite_sentinel([loss_mean] + list(diag.values()))
+                diag["diag/nonfinite"] = jnp.maximum(
+                    s, (bad_params > 0).astype(f32)
+                )
+        return new_p, new_m, new_e, loss_mean, aux_sum, diag
 
     m_spec = (P(WORKERS) if m_kind == KIND_DENSE else P())
     e_spec = (P(WORKERS) if e_kind == KIND_DENSE else P())
@@ -260,14 +316,14 @@ def build_fsdp_round_fn(
         body,
         mesh=mesh,
         in_specs=(shard, m_spec, e_spec, shard, shard, P(), P()),
-        out_specs=(shard, m_spec, e_spec, P(), P()),
+        out_specs=(shard, m_spec, e_spec, P(), P(), P()),
     )
 
     def round_fn(state: FedState, client_ids, batch, lr):
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
         m = state.momentum if has_m else jnp.zeros((nsh,), f32)
         e = state.error if has_e else jnp.zeros((nsh,), f32)
-        new_p, new_m, new_e, loss, aux = mapped(
+        new_p, new_m, new_e, loss, aux, diag = mapped(
             state.params_vec, m, e, batch, client_ids, rng, lr
         )
         new_state = FedState(
@@ -278,6 +334,6 @@ def build_fsdp_round_fn(
             client_err=(),
             step=state.step + 1,
         )
-        return new_state, {"loss": loss, **aux}
+        return new_state, {"loss": loss, **aux, **diag}
 
     return jax.jit(round_fn, donate_argnums=(0,))

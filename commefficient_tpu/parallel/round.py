@@ -73,6 +73,7 @@ from commefficient_tpu.models.losses import IGNORE_INDEX
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
 from commefficient_tpu.parallel.mesh import WORKERS
+from commefficient_tpu.telemetry import round_diagnostics
 from commefficient_tpu.utils.config import Config
 from commefficient_tpu.utils.jax_compat import (
     grad_extra_axes_psum,
@@ -350,6 +351,22 @@ def build_round_fn(
             delta = comp.topk(delta, cfg.k)
         new_params = state.params_vec - delta
         metrics = {"loss": loss, **aux}
+        if cfg.telemetry_level >= 1:
+            # in-graph health diagnostics (telemetry/diagnostics.py): ride
+            # the metrics dict -> the deferred drain path, no extra fences.
+            # The gate is python-level at trace time, so level 0 traces
+            # NOTHING here (bit-identical round; HLO smoke test).
+            with jax.named_scope("telemetry_diag"):
+                metrics.update(round_diagnostics(
+                    cfg, comp,
+                    agg=agg, delta=delta, new_params=new_params,
+                    loss=loss, lr=lr,
+                    momentum=state.momentum, error=state.error,
+                    extra=state.comp, new_error=new_e,
+                    client_err_rows=(
+                        new_err if cfg.error_type == "local" else None
+                    ),
+                ))
         if cfg.offload_client_state:
             new_state = FedState(
                 new_params, new_m, new_e, (), (), state.step + 1, new_comp

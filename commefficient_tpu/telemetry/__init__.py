@@ -1,0 +1,101 @@
+"""Round-level telemetry — the observability layer over the federated round.
+
+FetchSGD's headline claim is accuracy *per byte communicated*
+(arXiv:2007.07682 plots loss against bytes, not rounds), and its
+correctness hinges on the error-feedback residual staying bounded (the
+sketched-SGD analysis, arXiv:1903.04488, bounds exactly that buffer). This
+package makes both observable per round, in three pillars:
+
+  * ``diagnostics`` — in-graph health scalars (grad/update/EF-residual
+    norms, compressor fidelity, a non-finite sentinel) computed INSIDE the
+    jitted round and returned with the existing metrics dict, so they ride
+    the deferred ``drain_round_metrics`` path with no extra dispatch
+    fences. Gated by ``cfg.telemetry_level``: at level 0 nothing is traced
+    (the round's HLO is bit-identical to the pre-telemetry program — pinned
+    by the golden parity recordings and an HLO smoke test).
+  * ``ledger`` — per-round and cumulative uplink/downlink bytes sourced
+    from each ``Compressor``'s accounting, emitted as ``comm/*`` scalars
+    (so ACCURACY runs can plot loss-vs-bytes — the paper's x-axis) and
+    summarized in a ``comm_ledger.json`` per run dir.
+  * ``flight`` — a ring buffer of the last K drained round records plus
+    run metadata; on a non-finite sentinel or an uncaught train-loop
+    exception it dumps ``flight_<step>.json`` and raises a
+    ``DivergenceError`` naming the first bad round instead of training
+    onward on NaNs.
+
+Telemetry levels (``--telemetry_level``):
+
+  0 — off (default). Zero traced ops, zero host work; bit-identical rounds.
+  1 — health: diag/* norms + sentinel, comm/* scalars, flight recorder.
+      Cost: a handful of [D] reductions inside the already-running round.
+  2 — + compressor fidelity (sketch round-trip estimation error: one extra
+      sketch+estimate pass; powersgd reconstruction residual: vector ops
+      only). Intended for ACCURACY runs, not peak-throughput benches.
+
+Layering: ``diagnostics`` imports only jax + ops (L0 — the AMS table
+estimator lives with the sketch kernels); ``ledger``/``flight`` are
+host-side stdlib-only. ``parallel/`` and ``train/`` import this package;
+``compress/`` does NOT (its per-mode ``diagnostics()`` hook lives on the
+Compressor classes, keeping the compress layering at ops+jax).
+"""
+
+from commefficient_tpu.telemetry.diagnostics import (
+    nonfinite_sentinel,
+    round_diagnostics,
+    table_sqnorm_estimate,
+)
+from commefficient_tpu.telemetry.flight import (
+    DivergenceError,
+    FlightRecorder,
+    jsonable_scalar,
+    jsonable_tree,
+)
+from commefficient_tpu.telemetry.ledger import CommLedger, run_metadata
+
+# versioned schema shared by metrics.jsonl headers, flight_*.json and
+# comm_ledger.json (scripts/check_telemetry_schema.py validates against it)
+SCHEMA_VERSION = 1
+
+TELEMETRY_LEVELS = (0, 1, 2)
+
+
+def build_telemetry_riders(cfg, session, writer):
+    """(ledger, flight) for a train loop, or (None, None) below level 1 /
+    without a writer — the ONE construction both train entries share, so
+    the wiring cannot drift between them. ``session`` is duck-typed (needs
+    ``bytes_per_round()``, ``grad_size``, ``mesh``)."""
+    if getattr(cfg, "telemetry_level", 0) < 1 or writer is None:
+        return None, None
+    ledger = CommLedger(session.bytes_per_round(), mode=cfg.mode,
+                        num_workers=cfg.num_workers)
+    flight = FlightRecorder(
+        cfg, logdir=writer.logdir,
+        extra_meta={"grad_size": session.grad_size,
+                    "mesh": dict(zip(session.mesh.axis_names,
+                                     session.mesh.devices.shape))},
+    )
+    return ledger, flight
+
+
+def record_crash(flight, exc) -> None:
+    """Train-loop except hook: dump the flight trajectory for a crash that
+    is NOT a divergence (divergence already dumped its own record inside
+    the drain). No-op without a flight recorder."""
+    if flight is not None and not isinstance(exc, DivergenceError):
+        flight.on_exception(exc)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "TELEMETRY_LEVELS",
+    "CommLedger",
+    "DivergenceError",
+    "FlightRecorder",
+    "build_telemetry_riders",
+    "jsonable_scalar",
+    "jsonable_tree",
+    "nonfinite_sentinel",
+    "record_crash",
+    "round_diagnostics",
+    "run_metadata",
+    "table_sqnorm_estimate",
+]

@@ -1,0 +1,108 @@
+"""In-graph health diagnostics for the jitted federated round.
+
+Every function here runs UNDER JIT, called by the round builders
+(``parallel/round.py``, ``parallel/fsdp.py``) while tracing — the
+diagnostics ride the round's existing output dict and are fetched by the
+deferred ``drain_round_metrics`` pack, so they add zero dispatch fences.
+The ``cfg.telemetry_level`` gate is a PYTHON-level branch at trace time:
+at level 0 none of this is traced at all, so the compiled program is
+bit-identical to a pre-telemetry round (pinned by the golden parity
+recordings and the HLO smoke test in tests/test_telemetry.py — the
+non-finite sentinel is the only ``is_finite`` op in the round, so its
+absence from the lowered HLO proves the whole diag block was never
+traced).
+
+Scalar semantics (the ``diag/*`` schema; README "Observability"):
+
+  diag/grad_norm         — L2 norm of the psum-averaged decoded aggregate:
+                           the exact global (clipped, decayed) gradient
+                           norm for dense-transmit modes; the AMS estimate
+                           (median of row sq-norms) in sketch mode, whose
+                           aggregate only exists as an [r, c] table; the
+                           aggregated post-top-k transmit for local_topk.
+  diag/update_norm       — L2 norm of the APPLIED server delta (w -= delta).
+  diag/ef_residual_norm  — L2 norm of the error-feedback residual AFTER
+                           this round's extract-and-subtract: the server
+                           bank for virtual error (AMS-estimated for the
+                           sketched bank), the MEAN over this round's
+                           participant rows for local error.
+  diag/ef_residual_max   — max over participant rows (local error); equals
+                           ef_residual_norm for the single server bank.
+  diag/nonfinite         — 1.0 iff anything in {loss, the norms above, the
+                           new param vector} is NaN/Inf; the flight
+                           recorder's divergence trigger.
+  diag/<mode fidelity>   — level >= 2 only, per-compressor
+                           (``Compressor.diagnostics``/``fidelity``):
+                           sketch_est_rel_err, powersgd_recon_rel_err.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+# re-exported here so diagnostics consumers (parallel/fsdp.py) take it from
+# the telemetry namespace; the single implementation lives with the sketch
+# kernels (ops/countsketch.py — compress/sketch.py uses it there too)
+from commefficient_tpu.ops.countsketch import table_sqnorm_estimate  # noqa: F401
+
+
+def nonfinite_sentinel(scalars, vecs=()) -> jnp.ndarray:
+    """1.0 iff any scalar or any vector element is NaN/Inf, else 0.0.
+
+    The ONLY diagnostics op family that lowers to ``is_finite`` HLO — the
+    level-0 smoke test keys on that (tests/test_telemetry.py)."""
+    ok = jnp.bool_(True)
+    for s in scalars:
+        ok = ok & jnp.isfinite(jnp.asarray(s))
+    for v in vecs:
+        ok = ok & jnp.all(jnp.isfinite(v))
+    return 1.0 - ok.astype(jnp.float32)
+
+
+def round_diagnostics(
+    cfg,
+    comp,
+    *,
+    agg: Any,
+    delta: jnp.ndarray,
+    new_params: jnp.ndarray,
+    loss: jnp.ndarray,
+    lr,
+    momentum: Any,
+    error: Any,
+    extra: Any,
+    new_error: Any,
+    client_err_rows: Optional[jnp.ndarray] = None,
+) -> dict:
+    """The replicated round's diag dict, ``{"diag/...": scalar}``.
+
+    Args mirror the server-update site in ``build_round_fn``: ``momentum``/
+    ``error``/``extra`` are the PRE-update FedState leaves (what
+    ``server_update`` consumed — fidelity diagnostics recompute from them),
+    ``new_error`` the post-extract bank, ``client_err_rows`` the round's
+    [W, D] per-client residual rows when error feedback is local (None
+    otherwise). Returns {} below level 1 as a second line of defense — the
+    round builders already skip the call entirely at level 0 so nothing is
+    traced."""
+    level = getattr(cfg, "telemetry_level", 0)
+    if level < 1:
+        return {}
+    diag = comp.diagnostics(
+        level,
+        agg=agg,
+        delta=delta,
+        momentum=momentum,
+        error=error,
+        extra=extra,
+        new_error=new_error,
+        lr=lr,
+    )
+    if client_err_rows is not None:
+        row_norms = jnp.sqrt(jnp.sum(jnp.square(client_err_rows), axis=-1))
+        diag["ef_residual_norm"] = jnp.mean(row_norms)
+        diag["ef_residual_max"] = jnp.max(row_norms)
+    finite_scalars = [loss] + [v for v in diag.values()]
+    diag["nonfinite"] = nonfinite_sentinel(finite_scalars, vecs=(new_params,))
+    return {f"diag/{k}": v for k, v in diag.items()}

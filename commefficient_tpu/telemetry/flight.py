@@ -1,0 +1,163 @@
+"""Divergence flight recorder — forensics instead of hours of NaN training.
+
+Before this module a diverging run surfaced as a NaN val loss at the next
+epoch boundary (or a garbage checkpoint hours later) with no record of HOW
+it got there. The recorder keeps a ring buffer of the last
+``cfg.flight_window`` DRAINED round records (step, lr, every train/diag/
+comm scalar) plus run metadata; when the in-graph non-finite sentinel
+fires — or the train loop dies on an uncaught exception — it dumps
+``flight_<step>.json`` into the run dir and, for divergence, raises an
+actionable ``DivergenceError`` naming the FIRST bad round. Because
+detection rides the deferred drain, the first bad round is at most one
+drain interval (an epoch, or a checkpoint boundary) behind the live round
+clock — the ring buffer is sized so the pre-divergence trajectory is still
+in it.
+
+The record format is versioned (telemetry.SCHEMA_VERSION) and validated by
+``scripts/check_telemetry_schema.py``; see README "Observability".
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from collections import deque
+from typing import Optional
+
+
+class DivergenceError(RuntimeError):
+    """Training produced a non-finite signal; ``step`` is the first bad
+    round, ``path`` the flight record dumped for it."""
+
+    def __init__(self, step: int, reason: str, path: Optional[str]):
+        self.step = step
+        self.reason = reason
+        self.path = path
+        where = f"; flight record: {path}" if path else ""
+        super().__init__(
+            f"non-finite training signal first detected at round {step} "
+            f"({reason}){where}. Common causes: lr_scale too high for the "
+            "mode, sketch d/c outside the stable envelope (see the "
+            "FederatedSession warning / parallel/envelope.py), or "
+            "momentum_dampening combinations the config docs flag as "
+            "divergent. The flight record holds the last rounds' diag/* "
+            "norms — a blowing-up diag/ef_residual_norm implicates the "
+            "error-feedback loop; a clean trajectory ending in one bad "
+            "round implicates the data/batch at that step."
+        )
+
+
+def jsonable_scalar(v):
+    """Scalars only, NaN/Inf made strict-JSON-legal as "nan"/"inf"/"-inf"
+    markers (json.dump emits bare NaN tokens otherwise, which strict
+    parsers reject — and a diverging run is exactly when these files carry
+    non-finite values). Shared by the flight records and MetricsWriter's
+    jsonl scalars; the schema checker accepts numbers or these markers."""
+    f = float(v)
+    if math.isnan(f):
+        return "nan"
+    if math.isinf(f):
+        return "inf" if f > 0 else "-inf"
+    return f
+
+
+def jsonable_tree(obj):
+    """``jsonable_scalar`` applied through nested dicts/lists/tuples: the
+    dumped flight/header objects embed arbitrary config snapshots and
+    metadata, and a non-finite float ANYWHERE in them (a sweep-produced NaN
+    lr_scale is precisely a divergence scenario) must not poison the whole
+    artifact with a bare NaN token. Every artifact writer dumps with
+    ``allow_nan=False`` after this pass, so a miss is a loud error at write
+    time, not a corrupt file at read time."""
+    if isinstance(obj, dict):
+        return {k: jsonable_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable_tree(v) for v in obj]
+    if isinstance(obj, float):
+        return jsonable_scalar(obj)
+    return obj
+
+
+class FlightRecorder:
+    """Ring buffer of drained round records + crash/divergence dumper.
+
+    Constructed by the train loops at ``telemetry_level >= 1``; inert when
+    ``logdir`` is falsy. ``record`` appends a drained round; ``check``
+    raises ``DivergenceError`` (after dumping) when that round's signals
+    are non-finite; ``on_exception`` dumps the trajectory for any other
+    train-loop crash so post-mortems see the last healthy rounds.
+    """
+
+    def __init__(self, cfg=None, logdir: str = "", window: Optional[int] = None,
+                 extra_meta: Optional[dict] = None):
+        from commefficient_tpu.telemetry.ledger import run_metadata
+
+        self.logdir = logdir
+        self.window = int(
+            window if window is not None
+            else getattr(cfg, "flight_window", 16)
+        )
+        self.meta = run_metadata(cfg, extra_meta)
+        self.records: deque = deque(maxlen=self.window)
+        self.last_step: Optional[int] = None
+
+    def record(self, step: int, lr: float, scalars: dict) -> None:
+        self.last_step = int(step)
+        self.records.append({
+            "step": int(step),
+            "lr": jsonable_scalar(lr),
+            "scalars": {k: jsonable_scalar(v) for k, v in scalars.items()},
+        })
+
+    def check(self, step: int, loss: float, scalars: dict) -> None:
+        """Raise ``DivergenceError`` iff this drained round is bad: a
+        non-finite loss, or the in-graph sentinel (``diag/nonfinite``)
+        reporting a non-finite norm/param anywhere in the round. Called in
+        drain (= step) order, so the first raise names the FIRST bad
+        round."""
+        reasons = []
+        if not math.isfinite(float(loss)):
+            reasons.append(f"loss={float(loss)}")
+        sentinel = float(scalars.get("diag/nonfinite", 0.0))
+        if sentinel > 0.0 or not math.isfinite(sentinel):
+            reasons.append("diag/nonfinite sentinel fired (non-finite "
+                           "norm or parameter in the round)")
+        if not reasons:
+            return
+        path = self.dump(step, reason="; ".join(reasons), first_bad_step=step)
+        raise DivergenceError(int(step), "; ".join(reasons), path)
+
+    def on_exception(self, exc: BaseException) -> Optional[str]:
+        """Dump the trajectory for an uncaught train-loop exception (the
+        non-divergence crash path); returns the dump path."""
+        step = self.last_step if self.last_step is not None else -1
+        return self.dump(
+            step,
+            reason=f"uncaught {type(exc).__name__}: {exc}"[:500],
+            first_bad_step=None,
+        )
+
+    def dump(self, step: int, *, reason: str,
+             first_bad_step: Optional[int]) -> Optional[str]:
+        if not self.logdir:
+            return None
+        from commefficient_tpu.telemetry import SCHEMA_VERSION
+
+        os.makedirs(self.logdir, exist_ok=True)
+        path = os.path.join(self.logdir, f"flight_{int(step)}.json")
+        with open(path, "w") as f:
+            json.dump(
+                jsonable_tree({
+                    "schema_version": SCHEMA_VERSION,
+                    "reason": reason,
+                    "first_bad_step": first_bad_step,
+                    "window": self.window,
+                    "meta": self.meta,
+                    "records": list(self.records),
+                }),
+                f,
+                indent=2,
+                allow_nan=False,
+            )
+        return path

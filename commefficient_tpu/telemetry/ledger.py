@@ -1,0 +1,117 @@
+"""Communication ledger — loss-vs-BYTES is the paper's actual x-axis.
+
+FetchSGD's headline figures plot accuracy against bytes communicated, not
+rounds; this module turns each ``Compressor``'s ``upload_floats`` /
+``download_floats`` accounting (the ``bytes_per_round`` dict PR 2 put on
+the compressor classes) into per-round ``comm/*`` scalars riding
+``drain_round_metrics`` and a ``comm_ledger.json`` summary per run dir, so
+ACCURACY runs can draw the paper's curves directly from ``metrics.jsonl``.
+
+All byte counts are per PARTICIPATING CLIENT per round (the reference's
+own accounting in BASELINE.md — compression ratios are per-client-link
+properties); ``num_workers`` rides the ledger so fleet totals are one
+multiply away. Counts are exact ints: ``cum_up_bytes`` after R drained
+rounds is EXACTLY ``R * bytes_per_round["upload_bytes"]`` (pinned per mode
+by tests/test_telemetry.py). A resumed run counts only the rounds THIS
+process drained — the ledger is an observer of the live process, not a
+reconstruction of the whole training history (the per-step ``comm/cum_*``
+scalars in metrics.jsonl are what survives across resumes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Dict, Optional
+
+
+def run_metadata(cfg=None, extra: Optional[dict] = None) -> dict:
+    """The run-identifying metadata block shared by the metrics.jsonl
+    header, flight records, and the comm ledger: config snapshot, jax +
+    device identity, wall-clock start. ``cfg`` is duck-typed (a
+    ``utils.config.Config`` dataclass normally; any mapping-convertible
+    object otherwise)."""
+    meta: dict = {
+        "time": time.time(),
+        "start_time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    try:
+        import jax
+
+        devs = jax.devices()
+        meta["jax_version"] = jax.__version__
+        meta["device_kind"] = devs[0].device_kind
+        meta["device_count"] = len(devs)
+        meta["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — metadata must never kill a run
+        pass
+    if cfg is not None:
+        if dataclasses.is_dataclass(cfg):
+            meta["config"] = dataclasses.asdict(cfg)
+        else:
+            meta["config"] = {
+                k: v for k, v in vars(cfg).items() if not k.startswith("_")
+            }
+    if extra:
+        meta.update(extra)
+    return meta
+
+
+class CommLedger:
+    """Exact uplink/downlink byte accounting over the drained rounds.
+
+    ``on_round(step)`` is called once per DRAINED round (drain order ==
+    step order) and returns the scalars to emit at that step; ``write``
+    persists the summary. Constructed by the train loops at
+    ``telemetry_level >= 1`` from ``session.bytes_per_round()`` — the same
+    numbers the session prints at startup, so the ledger can never drift
+    from the accounting the compressor declares.
+    """
+
+    def __init__(self, bytes_per_round: Dict[str, int], *, mode: str,
+                 num_workers: int):
+        self.bytes_per_round = {k: int(v) for k, v in bytes_per_round.items()}
+        self.mode = mode
+        self.num_workers = int(num_workers)
+        self.rounds = 0
+        self.cum_up_bytes = 0
+        self.cum_down_bytes = 0
+
+    def on_round(self, step: int) -> Dict[str, float]:
+        """Account one drained round; returns this step's comm/* scalars."""
+        up = self.bytes_per_round["upload_bytes"]
+        down = self.bytes_per_round["download_bytes"]
+        self.rounds += 1
+        self.cum_up_bytes += up
+        self.cum_down_bytes += down
+        return {
+            "comm/up_bytes": up,
+            "comm/down_bytes": down,
+            "comm/cum_up_bytes": self.cum_up_bytes,
+            "comm/cum_down_bytes": self.cum_down_bytes,
+            "comm/cum_bytes": self.cum_up_bytes + self.cum_down_bytes,
+        }
+
+    def summary(self) -> dict:
+        from commefficient_tpu.telemetry import SCHEMA_VERSION
+
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "mode": self.mode,
+            "num_workers": self.num_workers,
+            "bytes_per_round": self.bytes_per_round,
+            "rounds": self.rounds,
+            "cum_up_bytes": self.cum_up_bytes,
+            "cum_down_bytes": self.cum_down_bytes,
+            "cum_bytes": self.cum_up_bytes + self.cum_down_bytes,
+        }
+
+    def write(self, logdir: str) -> str:
+        """Write ``comm_ledger.json`` into the run dir; returns the path."""
+        os.makedirs(logdir, exist_ok=True)
+        path = os.path.join(logdir, "comm_ledger.json")
+        with open(path, "w") as f:
+            json.dump(self.summary(), f, indent=2)
+        return path

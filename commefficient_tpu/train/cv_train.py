@@ -173,78 +173,97 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     table = table or TableLogger()
     timer = Timer()
+    from commefficient_tpu.telemetry import build_telemetry_riders, record_crash
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
+    # telemetry riders (level >= 1): the comm ledger sources the SAME
+    # bytes_per_round accounting the session prints at startup; the flight
+    # recorder dumps flight_<step>.json + raises DivergenceError on a
+    # non-finite round (see telemetry/ package docstring)
+    ledger, flight = build_telemetry_riders(cfg, session, writer)
     val = {}
     step = 0
     if checkpointer is not None and cfg.resume:
         restored = checkpointer.restore(session)
         if restored is not None:
             step = restored
+            profiler.resume_at(step)  # clamp the trace window post-resume
             print(f"resumed from checkpoint at round {step}")
-    for epoch in range(step // steps_per_epoch, cfg.num_epochs):
-        timer()
-        pending = []  # (step, lr, device-metrics); see drain_round_metrics
-        train_loss, train_correct, train_count = 0.0, 0.0, 0.0
+    try:
+        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
+            timer()
+            pending = []  # (step, lr, device-metrics); see drain_round_metrics
+            train_loss, train_correct, train_count = 0.0, 0.0, 0.0
 
-        def acc(loss, metrics):
-            nonlocal train_loss, train_correct, train_count
-            train_loss += loss
-            train_correct += float(metrics.get("correct", 0.0))
-            train_count += float(metrics.get("count", 0.0))
+            def acc(loss, metrics):
+                nonlocal train_loss, train_correct, train_count
+                train_loss += loss
+                train_correct += float(metrics.get("correct", 0.0))
+                train_count += float(metrics.get("count", 0.0))
 
-        drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
+            drain = lambda: drain_round_metrics(  # noqa: E731
+                pending, writer, acc, ledger=ledger, flight=flight
+            )
 
-        use_idx = getattr(session, "_dev_data", None) is not None
-        rounds = (
-            prefetch(sampler.epoch_indices(epoch))
-            if use_idx
-            else prefetch(sampler.epoch(epoch))
-        )
-        for round_idx, item in enumerate(rounds):
-            if epoch * steps_per_epoch + round_idx < step:
-                continue  # fast-forward within the resumed epoch
-            lr = float(lr_fn(step))
-            profiler.step(step)
-            if use_idx:
-                client_ids, idx, plan = item
-                metrics = session.train_round_indices(client_ids, idx, plan, lr)
-            else:
-                client_ids, batch = item
-                L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
-                if L:
-                    batch = {
-                        k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                        for k, v in batch.items()
-                    }
-                metrics = session.train_round(client_ids, batch, lr)
-            pending.append((step, lr, metrics))
-            step += 1
-            if checkpointer is not None:
-                if checkpointer.will_save(step):
-                    drain()
-                checkpointer.maybe_save(session, step)
-        drain()
-        train_time = timer()
-        val = session.evaluate(test_ds.eval_batches(eval_batch_size))
-        val_time = timer()
-        row = {
-            "epoch": epoch + 1,
-            "lr": lr,
-            "train_loss": train_loss / steps_per_epoch,
-            "train_acc": train_correct / max(train_count, 1.0),
-            "val_loss": val["loss"],
-            "val_acc": val.get("accuracy", float("nan")),
-            "train_time": train_time,
-            "val_time": val_time,
-        }
-        table.append(row)
-        if writer:
-            writer.scalar("val/loss", val["loss"], step)
-            writer.scalar("val/acc", val.get("accuracy", 0.0), step)
-            writer.flush()
-    profiler.close()
+            use_idx = getattr(session, "_dev_data", None) is not None
+            rounds = (
+                prefetch(sampler.epoch_indices(epoch))
+                if use_idx
+                else prefetch(sampler.epoch(epoch))
+            )
+            for round_idx, item in enumerate(rounds):
+                if epoch * steps_per_epoch + round_idx < step:
+                    continue  # fast-forward within the resumed epoch
+                lr = float(lr_fn(step))
+                profiler.step(step)
+                if use_idx:
+                    client_ids, idx, plan = item
+                    metrics = session.train_round_indices(client_ids, idx, plan, lr)
+                else:
+                    client_ids, batch = item
+                    L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
+                    if L:
+                        batch = {
+                            k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                            for k, v in batch.items()
+                        }
+                    metrics = session.train_round(client_ids, batch, lr)
+                pending.append((step, lr, metrics))
+                step += 1
+                if checkpointer is not None:
+                    if checkpointer.will_save(step):
+                        drain()
+                    checkpointer.maybe_save(session, step)
+            drain()
+            train_time = timer()
+            val = session.evaluate(test_ds.eval_batches(eval_batch_size))
+            val_time = timer()
+            row = {
+                "epoch": epoch + 1,
+                "lr": lr,
+                "train_loss": train_loss / steps_per_epoch,
+                "train_acc": train_correct / max(train_count, 1.0),
+                "val_loss": val["loss"],
+                "val_acc": val.get("accuracy", float("nan")),
+                "train_time": train_time,
+                "val_time": val_time,
+            }
+            table.append(row)
+            if writer:
+                writer.scalar("val/loss", val["loss"], step)
+                writer.scalar("val/acc", val.get("accuracy", 0.0), step)
+                writer.flush()
+    except Exception as e:
+        # divergence already dumped its own flight record in the drain;
+        # any OTHER crash dumps the recent trajectory for the post-mortem
+        record_crash(flight, e)
+        raise
+    finally:
+        profiler.close()
+        if ledger is not None:
+            # partial ledgers are still evidence — write on crash too
+            ledger.write(writer.logdir)
     if not val:
         # resumed at/after the final round (the epoch loop never ran):
         # still evaluate so callers get final metrics instead of a KeyError
@@ -272,7 +291,7 @@ def main(argv=None, **overrides):
     bpr = session.bytes_per_round()
     print(f"grad_size D={session.grad_size}  upload/client/round="
           f"{bpr['upload_bytes']:,} B  download={bpr['download_bytes']:,} B")
-    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg)
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 
     checkpointer = FedCheckpointer(cfg)

@@ -142,6 +142,12 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     table = table or TableLogger()
     timer = Timer()
+    from commefficient_tpu.telemetry import build_telemetry_riders, record_crash
+    from commefficient_tpu.utils.profiling import StepProfiler
+
+    profiler = StepProfiler(cfg.profile_dir)
+    # telemetry riders (level >= 1), shared constructor with cv_train
+    ledger, flight = build_telemetry_riders(cfg, session, writer)
     val = {}
     step = 0
     W = cfg.num_workers
@@ -149,81 +155,93 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
         restored = checkpointer.restore(session)
         if restored is not None:
             step = restored
+            profiler.resume_at(step)  # clamp the trace window post-resume
             print(f"resumed from checkpoint at round {step}")
-    for epoch in range(step // steps_per_epoch, cfg.num_epochs):
-        timer()
-        pending = []  # (step, lr, device-metrics); see drain_round_metrics
-        tr_loss = tr_lm = tr_mc = 0.0
+    try:
+        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
+            timer()
+            pending = []  # (step, lr, device-metrics); see drain_round_metrics
+            tr_loss = tr_lm = tr_mc = 0.0
 
-        def acc(loss, metrics):
-            nonlocal tr_loss, tr_lm, tr_mc
-            tr_loss += loss
-            # lm/mc aux are psum'd sums of per-client means -> / W
-            tr_lm += float(metrics.get("lm_loss", 0.0)) / W
-            tr_mc += float(metrics.get("mc_loss", 0.0)) / W
+            def acc(loss, metrics):
+                nonlocal tr_loss, tr_lm, tr_mc
+                tr_loss += loss
+                # lm/mc aux are psum'd sums of per-client means -> / W
+                tr_lm += float(metrics.get("lm_loss", 0.0)) / W
+                tr_mc += float(metrics.get("mc_loss", 0.0)) / W
 
-        drain = lambda: drain_round_metrics(pending, writer, acc)  # noqa: E731
-
-        use_idx = getattr(session, "_dev_data", None) is not None
-        rounds = (
-            prefetch(sampler.epoch_indices(epoch))
-            if use_idx
-            else prefetch(sampler.epoch(epoch))
-        )
-        for round_idx, item in enumerate(rounds):
-            if epoch * steps_per_epoch + round_idx < step:
-                continue  # fast-forward within the resumed epoch
-            lr = float(lr_fn(step))
-            if use_idx:
-                client_ids, idx, plan = item
-                metrics = session.train_round_indices(client_ids, idx, plan, lr)
-            else:
-                client_ids, batch = item
-                L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
-                if L:
-                    batch = {
-                        k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                        for k, v in batch.items()
-                    }
-                metrics = session.train_round(client_ids, batch, lr)
-            pending.append((step, lr, metrics))
-            step += 1
-            if checkpointer is not None:
-                if checkpointer.will_save(step):
-                    drain()
-                checkpointer.maybe_save(session, step)
-        drain()
-        train_time = timer()
-        val = evaluate_ppl(session, test_ds, eval_batch_size)
-        val_time = timer()
-        row = {
-            "epoch": epoch + 1,
-            "lr": lr,
-            "train_loss": tr_loss / steps_per_epoch,
-            "train_lm": tr_lm / steps_per_epoch,
-            "train_mc": tr_mc / steps_per_epoch,
-            "val_nll": val["nll"],
-            "val_ppl": val["ppl"],
-            "val_mc_acc": val["mc_accuracy"],
-            "train_time": train_time,
-            "val_time": val_time,
-        }
-        table.append(row)
-        if writer:
-            writer.scalar("val/nll", val["nll"], step)
-            writer.scalar("val/ppl", val["ppl"], step)
-            writer.scalar("val/mc_acc", val["mc_accuracy"], step)
-            writer.flush()
-        if gcfg is not None:
-            # periodic generation (reference gpt2_train eval ~L280-360)
-            from commefficient_tpu.data.personachat import SPECIAL_TOKENS
-
-            prompt, gen = sample_generation(
-                session, gcfg, test_ds,
-                base_vocab=gcfg.vocab_size - len(SPECIAL_TOKENS),
+            drain = lambda: drain_round_metrics(  # noqa: E731
+                pending, writer, acc, ledger=ledger, flight=flight
             )
-            print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
-                  f"-> {gen.tolist()}")
+
+            use_idx = getattr(session, "_dev_data", None) is not None
+            rounds = (
+                prefetch(sampler.epoch_indices(epoch))
+                if use_idx
+                else prefetch(sampler.epoch(epoch))
+            )
+            for round_idx, item in enumerate(rounds):
+                if epoch * steps_per_epoch + round_idx < step:
+                    continue  # fast-forward within the resumed epoch
+                lr = float(lr_fn(step))
+                profiler.step(step)
+                if use_idx:
+                    client_ids, idx, plan = item
+                    metrics = session.train_round_indices(client_ids, idx, plan, lr)
+                else:
+                    client_ids, batch = item
+                    L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
+                    if L:
+                        batch = {
+                            k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                            for k, v in batch.items()
+                        }
+                    metrics = session.train_round(client_ids, batch, lr)
+                pending.append((step, lr, metrics))
+                step += 1
+                if checkpointer is not None:
+                    if checkpointer.will_save(step):
+                        drain()
+                    checkpointer.maybe_save(session, step)
+            drain()
+            train_time = timer()
+            val = evaluate_ppl(session, test_ds, eval_batch_size)
+            val_time = timer()
+            row = {
+                "epoch": epoch + 1,
+                "lr": lr,
+                "train_loss": tr_loss / steps_per_epoch,
+                "train_lm": tr_lm / steps_per_epoch,
+                "train_mc": tr_mc / steps_per_epoch,
+                "val_nll": val["nll"],
+                "val_ppl": val["ppl"],
+                "val_mc_acc": val["mc_accuracy"],
+                "train_time": train_time,
+                "val_time": val_time,
+            }
+            table.append(row)
+            if writer:
+                writer.scalar("val/nll", val["nll"], step)
+                writer.scalar("val/ppl", val["ppl"], step)
+                writer.scalar("val/mc_acc", val["mc_accuracy"], step)
+                writer.flush()
+            if gcfg is not None:
+                # periodic generation (reference gpt2_train eval ~L280-360)
+                from commefficient_tpu.data.personachat import SPECIAL_TOKENS
+
+                prompt, gen = sample_generation(
+                    session, gcfg, test_ds,
+                    base_vocab=gcfg.vocab_size - len(SPECIAL_TOKENS),
+                )
+                print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
+                      f"-> {gen.tolist()}")
+    except Exception as e:
+        record_crash(flight, e)
+        raise
+    finally:
+        profiler.close()
+        if ledger is not None:
+            ledger.write(writer.logdir)
     if not val:
         # resumed at/after the final round (the epoch loop never ran):
         # still evaluate so callers get final metrics instead of a KeyError
@@ -360,7 +378,7 @@ def main(argv=None, **overrides):
     )
     # token arrays live in HBM when they fit; rounds ship only [W, B] indices
     session.maybe_attach_data(train, sampler)
-    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard)
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg)
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 
     # full-state checkpoints go under <checkpoint_dir>/state; the HF-format

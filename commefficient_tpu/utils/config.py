@@ -225,6 +225,21 @@ class Config:
     model_axis: int = 1
     seq_axis: int = 1
 
+    # --- telemetry (commefficient_tpu/telemetry/; TPU-native, no reference
+    # analog — the reference logs only train/loss + lr) ---
+    # 0 = off (default): the jitted round is bit-identical to a pre-
+    # telemetry program (nothing is traced; pinned by golden parity + the
+    # HLO smoke test). 1 = health: diag/* norms + non-finite sentinel
+    # in-graph, comm/* byte scalars, flight recorder. 2 = + compressor
+    # fidelity (sketch round-trip estimation error — one extra sketch+
+    # estimate pass per round; powersgd reconstruction residual — vector
+    # ops only). See telemetry/ package docstring for per-level cost.
+    telemetry_level: int = 0
+    # Ring-buffer size of the divergence flight recorder: how many drained
+    # round records ride in flight_<step>.json when a run goes non-finite
+    # (telemetry/flight.py). Active at telemetry_level >= 1.
+    flight_window: int = 16
+
     # --- misc (reference: --seed; the mesh-shape flags above are ours) ---
     seed: int = 42
     checkpoint_dir: str = ""
@@ -325,6 +340,15 @@ class Config:
             )
         if self.num_clients < self.num_workers:
             raise ValueError("num_clients must be >= num_workers")
+        if self.telemetry_level not in (0, 1, 2):
+            raise ValueError(
+                f"telemetry_level must be 0 (off), 1 (health) or 2 "
+                f"(+fidelity), got {self.telemetry_level!r}"
+            )
+        if self.flight_window < 1:
+            raise ValueError(
+                f"flight_window must be >= 1, got {self.flight_window}"
+            )
 
     @property
     def clients_per_device(self) -> int:

@@ -11,29 +11,57 @@ from __future__ import annotations
 
 import jax
 
+# The first executed round compiles and the second fills the other donated-
+# buffer layout (see bench.py's warmup note); a trace window that includes
+# them measures XLA, not the round. start_step=0 used to do exactly that —
+# now every window starts at least this many steps after the first executed
+# round.
+MIN_WARMUP_STEPS = 2
+
 
 class StepProfiler:
     """Trace rounds [start_step, start_step + num_steps) into ``logdir``.
 
-    Call ``step(i)`` once per training round; call ``close()`` in a finally
-    block. Inactive (zero overhead) when ``logdir`` is falsy.
+    Call ``step(i)`` once per executed training round (monotonic ``i``);
+    call ``resume_at(step0)`` after a checkpoint restore so the window
+    clamps to post-resume steps; call ``close()`` in a finally block.
+    Inactive (zero overhead) when ``logdir`` is falsy.
+
+    Window semantics: the trace starts at the first ``step()`` that lands
+    INSIDE the window (not only on exact equality with ``start_step`` — a
+    resume that fast-forwards into the middle of the window used to leave
+    the trace permanently un-started, and one that started could never
+    stop) and stops at the first step at/past the end. ``start_step`` is
+    clamped to at least ``MIN_WARMUP_STEPS`` so ``start_step=0`` cannot
+    trace compile+warmup.
     """
 
     def __init__(self, logdir: str, start_step: int = 5, num_steps: int = 3):
         self.logdir = logdir
-        self.start = start_step
-        self.stop_at = start_step + num_steps
+        self.num_steps = num_steps
+        self.start = max(start_step, MIN_WARMUP_STEPS)
+        self.stop_at = self.start + num_steps
         self._active = False
+
+    def resume_at(self, resume_step: int) -> None:
+        """Clamp the window to post-resume steps: the resumed process's
+        first executed round is ``resume_step`` and it compiles from
+        scratch, so any window overlapping or predating it shifts to
+        ``resume_step + MIN_WARMUP_STEPS`` (same length)."""
+        floor = resume_step + MIN_WARMUP_STEPS
+        if floor > self.start:
+            self.start = floor
+            self.stop_at = floor + self.num_steps
 
     def step(self, step_idx: int) -> None:
         if not self.logdir:
             return
-        if step_idx == self.start and not self._active:
-            jax.profiler.start_trace(self.logdir)
-            self._active = True
-        elif step_idx >= self.stop_at and self._active:
+        if self._active and step_idx >= self.stop_at:
             jax.profiler.stop_trace()
             self._active = False
+        elif not self._active and self.start <= step_idx < self.stop_at:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
 
     def close(self) -> None:
         if self._active:

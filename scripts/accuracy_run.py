@@ -36,14 +36,26 @@ def main():
                          "flat|concentrated|concentrated_v2 (v2 = the "
                          "dense-SGD-hostile r2/r3 parameterization; see "
                          "data/cifar.py)")
+    ap.add_argument("--telemetry_level", type=int, default=1,
+                    choices=(0, 1, 2),
+                    help="per-run telemetry (telemetry/ package): level 1 "
+                         "writes the loss-vs-BYTES curve — the paper's "
+                         "actual x-axis — into each run dir's "
+                         "metrics.jsonl (comm/cum_bytes vs train/loss) + "
+                         "comm_ledger.json; 0 restores the pre-telemetry "
+                         "bit-identical round")
+    ap.add_argument("--logdir", default="runs",
+                    help="root for the per-run metrics/ledger/flight dirs")
     args = ap.parse_args()
 
+    from commefficient_tpu.telemetry import DivergenceError
     from commefficient_tpu.train.cv_train import (
         build_model_and_data,
         build_session_and_sampler,
         train_loop,
     )
     from commefficient_tpu.utils.config import Config
+    from commefficient_tpu.utils.logging import MetricsWriter, make_logdir
 
     base = dict(
         dataset_name="cifar10", dataset_dir=args.dataset_dir, model="resnet9",
@@ -51,6 +63,7 @@ def main():
         num_clients=16, num_workers=8, num_devices=1, local_batch_size=64,
         weight_decay=5e-4, seed=42, topk_method="threshold",
         synthetic_variant=args.variant,
+        telemetry_level=args.telemetry_level, logdir=args.logdir,
     )
     k = 50_000
     # Per-mode (lr_scale, pivot_epoch), tuned by scripts/r3_sweep.py — the
@@ -134,8 +147,17 @@ def main():
             cfg, train, params, loss_fn, augment
         )
         bpr = session.bytes_per_round()
+        writer = MetricsWriter(make_logdir(cfg), cfg=cfg)
         t0 = time.time()
-        val = train_loop(cfg, session, sampler, test)
+        try:
+            val = train_loop(cfg, session, sampler, test, writer)
+        except DivergenceError as e:
+            # one diverging config must not kill the suite: its flight
+            # record has the forensics; the table gets an honest NaN row
+            print(f"== {name}: DIVERGED — {e}", flush=True)
+            val = {"loss": float("nan")}
+        finally:
+            writer.close()
         dt = time.time() - t0
         rows.append((name, cfg.lr_scale, cfg.pivot_epoch,
                      bpr["upload_bytes"], bpr["download_bytes"],

@@ -1,0 +1,271 @@
+"""Validate telemetry artifacts against the versioned schema.
+
+The telemetry subsystem writes three artifact kinds per run dir
+(README "Observability" documents the full schema; the version lives in
+``commefficient_tpu.telemetry.SCHEMA_VERSION``):
+
+  * ``metrics.jsonl``     — one run-header record per process, then scalar
+                            records ``{"name", "value", "step", "t"}``
+  * ``comm_ledger.json``  — cumulative communication accounting; the
+                            cumulative bytes must equal
+                            ``rounds * bytes_per_round`` EXACTLY
+  * ``flight_<step>.json``— divergence/crash flight record: metadata +
+                            ring-buffered round records in step order
+
+Consumers (plotting, run comparison, the driver's ACCURACY tooling) parse
+these blind, so the writers and this checker are pinned to each other by
+tests/test_telemetry_schema.py — the test writes artifacts through the
+REAL classes and validates them here, plus rejection cases (same pattern
+as scripts/check_mode_dispatch.py). Validators are hand-rolled: no
+jsonschema dependency in the container.
+
+    python scripts/check_telemetry_schema.py <run_dir> [...]  # exit 1 on bad
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+KNOWN_SCHEMA_VERSIONS = (1,)
+
+# scalar-name schema: bare "lr", or a namespaced name under one of the
+# documented prefixes (README "Observability")
+SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/")
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _strict_loads(s: str):
+    """json.loads that REJECTS bare NaN/Infinity tokens: Python's parser
+    accepts them, but the schema promises strict JSON (non-finite values
+    are stringified markers — telemetry.jsonable_scalar), so a writer
+    regression must fail here, not at some downstream jq/JS consumer."""
+
+    def _bad(tok):
+        raise SchemaError(f"bare {tok} token — not strict JSON")
+
+    return json.loads(s, parse_constant=_bad)
+
+
+def _req(record: dict, field: str, types, where: str):
+    if field not in record:
+        raise SchemaError(f"{where}: missing required field {field!r}")
+    if not isinstance(record[field], types):
+        raise SchemaError(
+            f"{where}: field {field!r} has type "
+            f"{type(record[field]).__name__}, expected {types}"
+        )
+    return record[field]
+
+
+def _check_version(record: dict, where: str) -> None:
+    v = _req(record, "schema_version", int, where)
+    if v not in KNOWN_SCHEMA_VERSIONS:
+        raise SchemaError(
+            f"{where}: unknown schema_version {v} "
+            f"(known: {KNOWN_SCHEMA_VERSIONS})"
+        )
+
+
+def _check_header(rec: dict, where: str) -> None:
+    _check_version(rec, where)
+    _req(rec, "time", (int, float), where)
+    _req(rec, "start_time", str, where)
+    if "config" in rec:
+        _req(rec, "config", dict, where)
+
+
+def _check_scalar_name(name: str, where: str,
+                       allow_bare_aux: bool = False) -> None:
+    """``allow_bare_aux``: flight records carry the round's RAW metric dict
+    (the packed drain output), whose workload aux keys are bare identifiers
+    (loss, correct, count, lm_loss, mc_loss, ...) next to the namespaced
+    diag/comm scalars; metrics.jsonl names stay strictly namespaced."""
+    if name == "lr":
+        return
+    if any(name.startswith(p) and len(name) > len(p)
+           for p in SCALAR_PREFIXES):
+        return
+    if allow_bare_aux and name.isidentifier() and "/" not in name:
+        return
+    raise SchemaError(
+        f"{where}: scalar name {name!r} outside the documented schema "
+        f"(lr | {'|'.join(p + '*' for p in SCALAR_PREFIXES)}"
+        + (" | bare aux identifier" if allow_bare_aux else "") + ")"
+    )
+
+
+def _check_scalar_value(v, name: str, where: str) -> None:
+    """Numbers, or the "nan"/"inf"/"-inf" markers non-finite values are
+    stringified to so every line stays strict JSON
+    (telemetry.jsonable_scalar)."""
+    if isinstance(v, bool) or (
+        not isinstance(v, (int, float)) and v not in ("nan", "inf", "-inf")
+    ):
+        raise SchemaError(
+            f"{where}: scalar {name!r} is neither a number nor a "
+            f"nan/inf marker: {v!r}"
+        )
+
+
+def validate_metrics_jsonl(path) -> int:
+    """Validate a metrics.jsonl; returns the number of scalar records."""
+    n_scalars = 0
+    saw_header = False
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            where = f"{path}:{i}"
+            try:
+                rec = _strict_loads(line)
+            except json.JSONDecodeError as e:
+                raise SchemaError(f"{where}: not valid JSON ({e.msg})")
+            except SchemaError as e:
+                raise SchemaError(f"{where}: {e}")
+            if not isinstance(rec, dict):
+                raise SchemaError(f"{where}: record is not an object")
+            if rec.get("type") == "header":
+                # one header per process; a resumed run appends another
+                _check_header(rec, where)
+                saw_header = True
+                continue
+            if i == 1:
+                raise SchemaError(
+                    f"{where}: first record must be the run header "
+                    "(type='header') — this file predates the header "
+                    "schema or was truncated"
+                )
+            name = _req(rec, "name", str, where)
+            _check_scalar_name(name, where)
+            if "value" not in rec:
+                raise SchemaError(f"{where}: missing required field 'value'")
+            _check_scalar_value(rec["value"], name, where)
+            step = _req(rec, "step", int, where)
+            if step < 0:
+                raise SchemaError(f"{where}: negative step {step}")
+            _req(rec, "t", (int, float), where)
+            n_scalars += 1
+    if not saw_header:
+        raise SchemaError(f"{path}: no run-header record")
+    return n_scalars
+
+
+def validate_comm_ledger(path) -> dict:
+    """Validate comm_ledger.json INCLUDING the exactness invariant:
+    cumulative bytes == rounds * bytes_per_round."""
+    where = str(path)
+    with open(path) as f:
+        rec = _strict_loads(f.read())
+    _check_version(rec, where)
+    _req(rec, "mode", str, where)
+    nw = _req(rec, "num_workers", int, where)
+    if nw < 1:
+        raise SchemaError(f"{where}: num_workers must be >= 1, got {nw}")
+    bpr = _req(rec, "bytes_per_round", dict, where)
+    for k in ("upload_floats", "download_floats", "upload_bytes",
+              "download_bytes"):
+        if not isinstance(bpr.get(k), int):
+            raise SchemaError(f"{where}: bytes_per_round[{k!r}] missing or "
+                              "not an int")
+    rounds = _req(rec, "rounds", int, where)
+    up = _req(rec, "cum_up_bytes", int, where)
+    down = _req(rec, "cum_down_bytes", int, where)
+    total = _req(rec, "cum_bytes", int, where)
+    if up != rounds * bpr["upload_bytes"]:
+        raise SchemaError(
+            f"{where}: cum_up_bytes {up} != rounds * upload_bytes "
+            f"({rounds} * {bpr['upload_bytes']})"
+        )
+    if down != rounds * bpr["download_bytes"]:
+        raise SchemaError(
+            f"{where}: cum_down_bytes {down} != rounds * download_bytes "
+            f"({rounds} * {bpr['download_bytes']})"
+        )
+    if total != up + down:
+        raise SchemaError(f"{where}: cum_bytes {total} != up + down")
+    return rec
+
+
+def validate_flight(path) -> dict:
+    """Validate a flight_<step>.json record."""
+    where = str(path)
+    with open(path) as f:
+        rec = _strict_loads(f.read())
+    _check_version(rec, where)
+    _req(rec, "reason", str, where)
+    if "first_bad_step" in rec and rec["first_bad_step"] is not None:
+        _req(rec, "first_bad_step", int, where)
+    window = _req(rec, "window", int, where)
+    if window < 1:
+        raise SchemaError(f"{where}: window must be >= 1")
+    _check_header({**_req(rec, "meta", dict, where),
+                   "schema_version": rec["schema_version"]}, where + ":meta")
+    records = _req(rec, "records", list, where)
+    if len(records) > window:
+        raise SchemaError(
+            f"{where}: {len(records)} records exceed the ring window "
+            f"{window}"
+        )
+    last = None
+    for j, r in enumerate(records):
+        w = f"{where}:records[{j}]"
+        step = _req(r, "step", int, w)
+        if "lr" not in r:
+            raise SchemaError(f"{w}: missing required field 'lr'")
+        _check_scalar_value(r["lr"], "lr", w)  # number or nan/inf marker
+        scalars = _req(r, "scalars", dict, w)
+        for name, v in scalars.items():
+            _check_scalar_name(name, w, allow_bare_aux=True)
+            _check_scalar_value(v, name, w)
+        if last is not None and step <= last:
+            raise SchemaError(f"{w}: records not in increasing step order")
+        last = step
+    return rec
+
+
+def validate_run_dir(run_dir) -> dict:
+    """Validate every telemetry artifact found under one run dir; returns
+    {artifact_path: summary}. Missing artifact kinds are fine (a level-0
+    run has only metrics.jsonl)."""
+    run_dir = Path(run_dir)
+    out = {}
+    metrics = run_dir / "metrics.jsonl"
+    if metrics.exists():
+        out[str(metrics)] = f"{validate_metrics_jsonl(metrics)} scalar(s)"
+    ledger = run_dir / "comm_ledger.json"
+    if ledger.exists():
+        rec = validate_comm_ledger(ledger)
+        out[str(ledger)] = (f"{rec['rounds']} round(s), "
+                            f"{rec['cum_bytes']} cum bytes")
+    for flight in sorted(run_dir.glob("flight_*.json")):
+        rec = validate_flight(flight)
+        out[str(flight)] = (f"{len(rec['records'])} record(s), "
+                            f"reason: {rec['reason'][:60]}")
+    if not out:
+        raise SchemaError(f"{run_dir}: no telemetry artifacts found")
+    return out
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    rc = 0
+    for run_dir in argv:
+        try:
+            for path, summary in validate_run_dir(run_dir).items():
+                print(f"OK   {path}: {summary}")
+        except SchemaError as e:
+            print(f"FAIL {e}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

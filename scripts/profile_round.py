@@ -60,6 +60,14 @@ def main():
         "matricize/GS/reconstruct phase lines)",
     )
     ap.add_argument("--powersgd_rank", type=int, default=4)
+    ap.add_argument(
+        "--telemetry_level", type=int, default=0, choices=(0, 1, 2),
+        help="telemetry level for the full-round ground-truth section: "
+        "0 is the bit-identical pre-telemetry round (the default, so the "
+        "headline number IS the no-overhead acceptance measurement); 1/2 "
+        "time the in-graph diagnostics tax (level 2 adds the sketch "
+        "round-trip fidelity / powersgd reconstruction residual)",
+    )
     args = ap.parse_args()
 
     from commefficient_tpu.models import ResNet9, classification_loss
@@ -202,7 +210,7 @@ def main():
                   topk_method="threshold", fuse_clients=True,
                   num_clients=2 * workers, num_workers=workers,
                   num_devices=1, local_batch_size=bench_batch,
-                  weight_decay=5e-4)
+                  weight_decay=5e-4, telemetry_level=args.telemetry_level)
     if args.mode == "powersgd":
         cfg = Config(mode="powersgd", powersgd_rank=rank, **common)
     else:
@@ -232,6 +240,8 @@ def main():
     fence(losses)
     dt = (time.perf_counter() - t0) / n * 1e3
     tag = args.mode if args.mode != "sketch" else args.sketch_backend
+    if args.telemetry_level:
+        tag += f"+telemetry_l{args.telemetry_level}"
     print(f"scanned full round [{tag}]: {dt:.2f} ms -> "
           f"{workers * bench_batch / dt * 1e3:,.0f} samples/s")
 

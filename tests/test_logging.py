@@ -1,0 +1,200 @@
+"""utils/logging tests: the deferred metrics path (pack/drain) and the
+writer/table satellites from the telemetry PR (previously untested)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from commefficient_tpu.utils.config import Config
+from commefficient_tpu.utils.logging import (
+    _PACKER_CACHE,
+    MetricsWriter,
+    TableLogger,
+    drain_round_metrics,
+    pack_metric_dicts,
+)
+
+
+class RecordingWriter:
+    """Minimal MetricsWriter stand-in recording (name, value, step) order."""
+
+    def __init__(self):
+        self.events = []
+        self.flushes = 0
+
+    def scalar(self, name, value, step):
+        self.events.append((name, float(value), int(step)))
+
+    def flush(self):
+        self.flushes += 1
+
+
+# ---------------------------------------------------------------------------
+# pack_metric_dicts
+# ---------------------------------------------------------------------------
+
+def test_pack_returns_named_matrix():
+    dicts = [{"loss": jnp.float32(j), "acc": jnp.float32(10 + j)}
+             for j in range(3)]
+    names, mat = pack_metric_dicts(dicts)
+    assert names == ("acc", "loss")
+    np.testing.assert_allclose(mat[:, names.index("loss")], [0, 1, 2])
+    np.testing.assert_allclose(mat[:, names.index("acc")], [10, 11, 12])
+
+
+def test_pack_cache_reused_across_same_shaped_epochs():
+    """Same (N, key set) must hit the jit cache — one compile per shape per
+    process, not per epoch (the whole point of the packed drain)."""
+    dicts = [{"loss": jnp.float32(j), "x": jnp.float32(j)} for j in range(4)]
+    pack_metric_dicts(dicts)
+    key = (4, ("loss", "x"))
+    assert key in _PACKER_CACHE
+    cached = _PACKER_CACHE[key]
+    pack_metric_dicts([{"loss": jnp.float32(9), "x": jnp.float32(9)}
+                       for _ in range(4)])  # second "epoch", same shape
+    assert _PACKER_CACHE[key] is cached
+
+
+def test_pack_rejects_mixed_key_sets():
+    dicts = [{"loss": jnp.float32(0)}, {"loss": jnp.float32(1),
+                                        "extra": jnp.float32(2)}]
+    with pytest.raises(ValueError, match="mixed"):
+        pack_metric_dicts(dicts)
+
+
+# ---------------------------------------------------------------------------
+# drain_round_metrics
+# ---------------------------------------------------------------------------
+
+def _pending(n, start=0):
+    return [(start + j, 0.1 * (j + 1),
+             {"loss": jnp.float32(j), "diag/grad_norm": jnp.float32(2 * j)})
+            for j in range(n)]
+
+
+def test_drain_writes_in_step_order_and_clears():
+    w = RecordingWriter()
+    acc = []
+    pending = _pending(4)
+    drain_round_metrics(pending, w, lambda loss, m: acc.append(loss))
+    assert pending == []
+    assert acc == [0.0, 1.0, 2.0, 3.0]
+    loss_steps = [s for n, _, s in w.events if n == "train/loss"]
+    assert loss_steps == [0, 1, 2, 3]
+    # namespaced metric keys are written as scalars verbatim
+    diag = [(v, s) for n, v, s in w.events if n == "diag/grad_norm"]
+    assert diag == [(0.0, 0), (2.0, 1), (4.0, 2), (6.0, 3)]
+    assert w.flushes == 1
+
+
+def test_drain_before_checkpoint_ordering(tmp_path):
+    """The train loops drain BEFORE a checkpoint write (will_save -> drain
+    -> maybe_save): every buffered round up to the save step must be on the
+    writer before the save happens — a resume fast-forwards past those
+    rounds, so anything unflushed at save time is lost for good. Replays
+    the loop's exact call sequence against the real FedCheckpointer
+    predicate."""
+    from commefficient_tpu.utils.checkpoint import FedCheckpointer
+
+    cfg = Config(checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=3)
+    ckpt = FedCheckpointer(cfg)
+    try:
+        w = RecordingWriter()
+        events = []  # interleaved ("scalar", step) / ("save", step)
+        orig = w.scalar
+
+        def scalar(name, value, step):
+            if name == "train/loss":
+                events.append(("scalar", step))
+            orig(name, value, step)
+
+        w.scalar = scalar
+        pending = []
+        step = 0
+        for r in range(7):
+            pending.append((step, 0.1, {"loss": jnp.float32(r)}))
+            step += 1
+            if ckpt.will_save(step):
+                drain_round_metrics(pending, w, lambda *a: None)
+                events.append(("save", step))
+        drain_round_metrics(pending, w, lambda *a: None)
+        saves = [s for kind, s in events if kind == "save"]
+        assert saves == [3, 6], "checkpoint predicate drifted"
+        for save_step in saves:
+            before = [s for kind, s in events[:events.index(("save", save_step))]
+                      if kind == "scalar"]
+            assert before == list(range(save_step)), (
+                f"rounds < {save_step} must be drained before the save"
+            )
+    finally:
+        ckpt.close()
+
+
+def test_drain_empty_is_noop():
+    w = RecordingWriter()
+    drain_round_metrics([], w, lambda *a: None)
+    assert w.events == [] and w.flushes == 0
+
+
+# ---------------------------------------------------------------------------
+# TableLogger (satellite: late keys must warn once + render, not vanish)
+# ---------------------------------------------------------------------------
+
+def test_table_logger_renders_late_keys(capsys):
+    t = TableLogger(width=8)
+    t.append({"epoch": 1, "loss": 1.5})
+    t.append({"epoch": 2, "loss": 1.2, "val_acc": 0.5})
+    t.append({"epoch": 3, "loss": 1.0, "val_acc": 0.75})
+    out = capsys.readouterr().out
+    lines = out.strip().splitlines()
+    warnings = [ln for ln in lines if "new column" in ln]
+    assert len(warnings) == 1 and "'val_acc'" in warnings[0]
+    # the late column's VALUES are rendered from its first appearance on
+    assert "0.5000" in out and "0.7500" in out
+    # rows stay aligned: every data row renders all known keys
+    assert lines[-1].count("|") == 2
+
+
+def test_table_logger_warns_once_per_key(capsys):
+    t = TableLogger()
+    t.append({"a": 1})
+    t.append({"a": 2, "b": 3})
+    t.append({"a": 4, "b": 5})
+    t.append({"a": 6, "b": 7, "c": 8})
+    out = capsys.readouterr().out
+    assert out.count("new column") == 2  # once for 'b', once for 'c'
+
+
+# ---------------------------------------------------------------------------
+# MetricsWriter (satellite: run header + wall-time field)
+# ---------------------------------------------------------------------------
+
+def test_metrics_writer_header_and_walltime(tmp_path):
+    cfg = Config(mode="sketch", error_type="virtual", k=7, num_rows=3,
+                 num_cols=64, virtual_momentum=0.9)
+    w = MetricsWriter(str(tmp_path), cfg=cfg)
+    w.scalar("train/loss", 1.25, 0)
+    w.close()
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    header, scalar = recs
+    assert header["type"] == "header"
+    assert header["schema_version"] == 1
+    assert header["config"]["mode"] == "sketch" and header["config"]["k"] == 7
+    assert isinstance(header["jax_version"], str)
+    assert "device_kind" in header and "start_time" in header
+    assert scalar == {"name": "train/loss", "value": 1.25, "step": 0,
+                      "t": pytest.approx(scalar["t"])}
+    assert scalar["t"] >= header["time"] > 0
+
+
+def test_metrics_writer_resume_appends_second_header(tmp_path):
+    for _ in range(2):  # two processes appending to one run dir
+        w = MetricsWriter(str(tmp_path))
+        w.scalar("train/loss", 1.0, 0)
+        w.close()
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    assert [r.get("type") for r in recs] == ["header", None, "header", None]

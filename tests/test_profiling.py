@@ -1,0 +1,110 @@
+"""StepProfiler window-edge tests (telemetry PR satellite).
+
+Two previously-broken edges: start_step=0 traced compile+warmup, and a
+checkpoint resume landing inside/past the window left the trace
+permanently un-started (exact-equality start) or un-stopped. The
+jax.profiler calls are monkeypatched — these tests pin WINDOW semantics,
+not trace content."""
+
+import pytest
+
+from commefficient_tpu.utils.profiling import MIN_WARMUP_STEPS, StepProfiler
+
+
+@pytest.fixture
+def trace(monkeypatch):
+    events = []
+    monkeypatch.setattr("jax.profiler.start_trace",
+                        lambda logdir: events.append("start"))
+    monkeypatch.setattr("jax.profiler.stop_trace",
+                        lambda: events.append("stop"))
+    return events
+
+
+def _drive(p, steps):
+    windows = []
+    for s in steps:
+        before = p._active
+        p.step(s)
+        if p._active and not before:
+            windows.append(["start", s])
+        if before and not p._active:
+            windows[-1].append(s)
+    return windows
+
+
+def test_start_step_zero_clamped_past_warmup(trace):
+    """start_step=0 must NOT trace the compile/warmup rounds."""
+    p = StepProfiler("dir", start_step=0, num_steps=2)
+    windows = _drive(p, range(8))
+    p.close()
+    assert windows == [["start", MIN_WARMUP_STEPS, MIN_WARMUP_STEPS + 2]]
+    assert trace == ["start", "stop"]
+
+
+def test_resume_past_window_clamps_forward(trace):
+    """Resume fast-forwarded PAST stop_at: the window must shift to
+    post-resume steps (it used to never start — and a started trace never
+    stopped — because start matched on exact equality)."""
+    p = StepProfiler("dir", start_step=5, num_steps=3)  # window [5, 8)
+    p.resume_at(20)
+    windows = _drive(p, range(20, 30))
+    p.close()
+    start = 20 + MIN_WARMUP_STEPS
+    assert windows == [["start", start, start + 3]]
+    assert trace == ["start", "stop"]
+
+
+def test_resume_inside_window_clamps_forward(trace):
+    """Resume landing INSIDE the window: trace only post-resume steps."""
+    p = StepProfiler("dir", start_step=5, num_steps=3)
+    p.resume_at(6)
+    windows = _drive(p, range(6, 16))
+    p.close()
+    assert windows == [["start", 6 + MIN_WARMUP_STEPS,
+                        6 + MIN_WARMUP_STEPS + 3]]
+
+
+def test_resume_before_window_keeps_configured_window(trace):
+    """A resume well before the window must not move it."""
+    p = StepProfiler("dir", start_step=10, num_steps=2)
+    p.resume_at(3)
+    windows = _drive(p, range(3, 16))
+    p.close()
+    assert windows == [["start", 10, 12]]
+
+
+def test_entering_mid_window_without_resume_still_stops(trace):
+    """Even if a caller forgets resume_at, a step sequence entering the
+    window mid-way starts the trace and STOPS it at the window end (the old
+    exact-equality start could leave a trace running forever)."""
+    p = StepProfiler("dir", start_step=5, num_steps=3)
+    windows = _drive(p, range(6, 12))
+    p.close()
+    assert windows == [["start", 6, 8]]
+    assert trace == ["start", "stop"]
+
+
+def test_close_stops_active_trace(trace):
+    p = StepProfiler("dir", start_step=2, num_steps=10)
+    p.step(2)
+    assert trace == ["start"]
+    p.close()
+    assert trace == ["start", "stop"]
+    p.close()  # idempotent
+    assert trace == ["start", "stop"]
+
+
+def test_inactive_without_logdir(trace):
+    p = StepProfiler("", start_step=0, num_steps=5)
+    for s in range(10):
+        p.step(s)
+    p.close()
+    assert trace == []
+
+
+def test_default_window_unchanged():
+    """The production default (start 5) predates the clamp and must not
+    move — only start_step below the warmup floor is clamped."""
+    p = StepProfiler("dir")
+    assert p.start == 5 and p.stop_at == 8

@@ -1,0 +1,205 @@
+"""Writer <-> schema pinning for the telemetry artifacts.
+
+Same pattern as tests/test_mode_dispatch.py: the checker script is loaded
+from scripts/ and exercised in tier-1. Artifacts are produced through the
+REAL writer classes (MetricsWriter, CommLedger, FlightRecorder), so a
+writer format change that breaks the documented schema fails here — and
+the rejection cases guard the checker against rotting into a vacuous
+pass."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from commefficient_tpu.telemetry import CommLedger, FlightRecorder
+from commefficient_tpu.utils.config import Config
+from commefficient_tpu.utils.logging import MetricsWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(REPO, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_run(tmp_path, rounds=3):
+    """A full artifact set through the real writers."""
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=10, num_rows=3, num_cols=64, telemetry_level=2)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    ledger = CommLedger({"upload_floats": 192, "download_floats": 20,
+                         "upload_bytes": 768, "download_bytes": 80},
+                        mode="sketch", num_workers=8)
+    flight = FlightRecorder(cfg, logdir=run_dir)
+    for s in range(rounds):
+        writer.scalar("train/loss", 1.0 / (s + 1), s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("diag/grad_norm", 0.5, s)
+        for k, v in ledger.on_round(s).items():
+            writer.scalar(k, v, s)
+        flight.record(s, 0.1, {"train/loss": 1.0 / (s + 1),
+                               "diag/nonfinite": 0.0})
+    writer.close()
+    ledger.write(run_dir)
+    flight.dump(rounds - 1, reason="test dump", first_bad_step=rounds - 1)
+    return run_dir
+
+
+def test_real_artifacts_validate(tmp_path):
+    mod = _checker()
+    out = mod.validate_run_dir(_write_run(tmp_path))
+    kinds = {os.path.basename(p) for p in out}
+    assert kinds == {"metrics.jsonl", "comm_ledger.json", "flight_2.json"}
+
+
+def test_artifacts_from_real_drain_path_validate(tmp_path):
+    """Review regression: the drain records the round's RAW metric dict
+    into the flight ring (bare aux keys: loss, correct, ...) and writes a
+    non-finite loss into metrics.jsonl — both must validate, through the
+    REAL drain_round_metrics, not hand-crafted records."""
+    import jax.numpy as jnp
+
+    from commefficient_tpu.telemetry import DivergenceError
+    from commefficient_tpu.utils.logging import drain_round_metrics
+
+    cfg = Config(mode="uncompressed", telemetry_level=1)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    flight = FlightRecorder(cfg, logdir=run_dir)
+    pending = [
+        (0, 0.1, {"loss": jnp.float32(1.0), "correct": jnp.float32(3.0),
+                  "count": jnp.float32(4.0),
+                  "diag/nonfinite": jnp.float32(0.0)}),
+        (1, 0.1, {"loss": jnp.float32(float("nan")),
+                  "correct": jnp.float32(0.0), "count": jnp.float32(4.0),
+                  "diag/nonfinite": jnp.float32(1.0)}),
+    ]
+    with pytest.raises(DivergenceError):
+        drain_round_metrics(pending, writer, lambda *a: None, flight=flight)
+    writer.close()
+    mod = _checker()
+    out = mod.validate_run_dir(run_dir)
+    assert {os.path.basename(p) for p in out} == {"metrics.jsonl",
+                                                  "flight_1.json"}
+    # the non-finite loss landed as a strict-JSON "nan" marker, not a bare
+    # NaN token
+    with open(os.path.join(run_dir, "metrics.jsonl")) as f:
+        content = f.read()
+    assert '"value": "nan"' in content and "NaN" not in content
+
+
+def test_flight_with_nonfinite_lr_and_config_stays_strict_json(tmp_path):
+    """Review regression: a non-finite lr or config float (a sweep-produced
+    NaN lr_scale IS a divergence scenario) must not emit bare NaN tokens
+    into the flight dump — jsonable_tree stringifies them and the artifact
+    still validates."""
+    import json as _json
+
+    cfg = Config(mode="uncompressed", telemetry_level=1,
+                 lr_scale=float("nan"))
+    flight = FlightRecorder(cfg, logdir=str(tmp_path))
+    flight.record(0, float("nan"), {"loss": 1.0})
+    path = flight.dump(0, reason="nan lr", first_bad_step=0)
+    content = open(path).read()
+    assert "NaN" not in content  # strict JSON, markers only
+    rec = _json.loads(content)
+    assert rec["records"][0]["lr"] == "nan"
+    assert rec["meta"]["config"]["lr_scale"] == "nan"
+    mod = _checker()
+    mod.validate_flight(path)
+
+
+def test_checker_rejects_bare_nan_token(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    with open(os.path.join(run_dir, "metrics.jsonl"), "a") as f:
+        f.write('{"name": "train/loss", "value": NaN, "step": 9, "t": 0.0}\n')
+    with pytest.raises(mod.SchemaError, match="bare NaN"):
+        mod.validate_metrics_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+
+
+def test_checker_rejects_missing_header(tmp_path):
+    mod = _checker()
+    p = tmp_path / "metrics.jsonl"
+    p.write_text('{"name": "train/loss", "value": 1.0, "step": 0, "t": 0}\n')
+    with pytest.raises(mod.SchemaError, match="header"):
+        mod.validate_metrics_jsonl(p)
+
+
+def test_checker_rejects_unknown_scalar_namespace(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    with open(os.path.join(run_dir, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({"name": "bogus/thing", "value": 1.0,
+                            "step": 9, "t": 0.0}) + "\n")
+    with pytest.raises(mod.SchemaError, match="bogus/thing"):
+        mod.validate_metrics_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+
+
+def test_checker_rejects_missing_walltime(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    with open(os.path.join(run_dir, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({"name": "train/loss", "value": 1.0,
+                            "step": 9}) + "\n")
+    with pytest.raises(mod.SchemaError, match="'t'"):
+        mod.validate_metrics_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+
+
+def test_checker_enforces_ledger_exactness(tmp_path):
+    """The checker itself enforces cum == rounds * bytes_per_round, so a
+    drifted ledger writer cannot validate."""
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    path = os.path.join(run_dir, "comm_ledger.json")
+    with open(path) as f:
+        rec = json.load(f)
+    rec["cum_up_bytes"] += 4
+    rec["cum_bytes"] += 4
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="cum_up_bytes"):
+        mod.validate_comm_ledger(path)
+
+
+def test_checker_rejects_out_of_order_flight_records(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    path = os.path.join(run_dir, "flight_2.json")
+    with open(path) as f:
+        rec = json.load(f)
+    rec["records"] = rec["records"][::-1]
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="step order"):
+        mod.validate_flight(path)
+
+
+def test_checker_rejects_unknown_schema_version(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    path = os.path.join(run_dir, "comm_ledger.json")
+    with open(path) as f:
+        rec = json.load(f)
+    rec["schema_version"] = 999
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="schema_version"):
+        mod.validate_comm_ledger(path)
+
+
+def test_cli_exit_codes(tmp_path):
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    assert mod.main([run_dir]) == 0
+    (tmp_path / "empty").mkdir()
+    assert mod.main([str(tmp_path / "empty")]) == 1
