@@ -230,17 +230,31 @@ def _measure(cfg, n_rounds: int = 20) -> float:
     state, round_fn = session.state, session.round_fn
     lr = jnp.float32(0.1)
 
+    # fedsim legs (sketch_dropout30): the masked round consumes one RoundEnv
+    # per round; realize the real environment's schedule up front so the
+    # timed loop measures the in-graph masking, not host mask draws
+    envs = [()] * (3 + n_rounds)
+    if cfg.fedsim_enabled:
+        from commefficient_tpu.fedsim import build_environment
+
+        fe = build_environment(cfg)
+        envs = []
+        for r in range(3 + n_rounds):
+            e = fe.round_env(r)
+            envs.append((jnp.asarray(e.live), jnp.asarray(e.corrupt),
+                         jnp.float32(e.live_count)))
+
     # compile + warmup: the first TWO calls compile (donated-buffer layouts
     # differ between the fresh state and the returned state), so warm both.
     # NB: block_until_ready is unreliable through the axon tunnel; a scalar
     # fetch is the only trustworthy fence.
-    for _ in range(3):
-        state, m = round_fn(state, ids, data, lr)
+    for i in range(3):
+        state, m = round_fn(state, ids, data, lr, env=envs[i])
         assert np.isfinite(float(m["loss"]))
 
     t0 = time.perf_counter()
-    for _ in range(n_rounds):
-        state, m = round_fn(state, ids, data, lr)
+    for i in range(n_rounds):
+        state, m = round_fn(state, ids, data, lr, env=envs[3 + i])
     assert np.isfinite(float(m["loss"]))  # fence
     dt = time.perf_counter() - t0
     return n_rounds * workers * batch / dt
@@ -301,9 +315,25 @@ def main():
             # round — tracks the observability tax against the level-0
             # headline (which is bit-identical to pre-telemetry rounds)
             "sketch_telemetry_l2": base.replace(telemetry_level=2),
+            # fedsim PR: the headline sketch round under bernoulli 30%
+            # dropout — masked per-client transmits (vmap path: masking
+            # disables the fused fast path) + live-count renormalization.
+            # Tracks the partial-participation tax against the fused
+            # headline AND against sketch_vmap_clip (its vmap twin).
+            "sketch_dropout30": base.replace(
+                availability="bernoulli", dropout_prob=0.3
+            ),
         }
         for name, cfg in matrix.items():
-            sps = _measure(cfg)
+            # per-leg error isolation (the GPT-2 legs' pattern): one leg's
+            # failure must not discard the others' measured rows
+            try:
+                sps = _measure(cfg)
+            except Exception as e:  # noqa: BLE001
+                rows[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+                print(json.dumps({"metric": name,
+                                  "error": rows[f"{name}_error"]}))
+                continue
             rows[name] = round(sps, 2)
             print(json.dumps({"metric": name, "value": rows[name],
                               "unit": "samples/s"}))

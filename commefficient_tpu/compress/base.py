@@ -232,3 +232,19 @@ class Compressor:
     def download_floats(self) -> int:
         """Downlink floats per round (before any do_topk_down top-k)."""
         return self.d
+
+    # ---- fedsim mask-aware accounting (telemetry/ledger.py) --------------
+    def masked_upload_floats(self, live_clients: int) -> int:
+        """Fleet uplink floats for a round in which only ``live_clients``
+        participated (fedsim masked aggregation): every registered mode's
+        per-client payload is participation-independent, so the fleet
+        uplink is LINEAR in the live count. The CommLedger's live-byte
+        exactness invariant (cum bytes == sum of live_i x upload_bytes)
+        leans on this hook rather than assuming linearity — a future mode
+        whose payload depends on the cohort overrides it here. (There is
+        deliberately no downlink twin: the masked downlink is
+        ``avail x bytes_per_round["download_bytes"]`` computed by the
+        ledger itself, because the per-client download figure already
+        carries the session-level do_topk_down adjustment that this class
+        cannot see.)"""
+        return int(live_clients) * self.upload_floats()

@@ -1,0 +1,81 @@
+"""Federated environment simulator — the unreliable world the rounds run in.
+
+FetchSGD's headline claim (arXiv:2007.07682) is robustness under *small,
+non-IID, partially-participating* client cohorts, and the sketched-SGD
+analysis (arXiv:1903.04488) hinges on error feedback surviving exactly that
+regime — yet the round engines assume every ``num_workers`` client arrives,
+computes, and transmits each round. This package models the federated
+world's failure modes and threads them through the jitted rounds:
+
+  * ``availability`` — a registry of seeded availability models (``always``
+    default, ``bernoulli`` iid per-client dropout, ``sine`` diurnal
+    participation, ``cohort`` correlated outages) emitting a per-round
+    ``[num_workers]`` participation mask from ``(round_idx, seed)`` —
+    deterministic and resume-stable, mirroring ``FedSampler.sample_round``
+    (same tuple-seeded rng discipline, a DISTINCT stream so masks never
+    perturb the batch draws).
+  * ``faults`` — chaos injection composed on top: straggler deadlines
+    (late clients excluded from aggregation, their local momentum/error
+    rows untouched), payload corruption (non-finite injection into a live
+    client's transmit — proves the telemetry flight-recorder /
+    ``DivergenceError`` path end-to-end), parsed from a scheduled plan
+    grammar: ``--chaos "dropout@0.3:rounds=50-100,nan_client@120"``.
+  * ``env`` — ``FedEnvironment`` composes the two into one ``RoundEnv``
+    per round (live mask, corruption mask, live count, host-side
+    ``fedsim/*`` telemetry scalars).
+
+Aggregation semantics (implemented in ``parallel/round.py`` /
+``parallel/fsdp.py``): masked clients transmit NOTHING (``jnp.where``, not
+multiply, so a zero mask also blocks a corrupted payload's NaN), masking
+happens BEFORE ``device_encode`` — which is LINEAR by the compress/
+psum-safety contract, so masking commutes with the encode for every
+registered mode — and the server renormalizes the psum-average by the LIVE
+count. A round with zero live clients freezes params + server state and
+flags ``fedsim/all_dropped`` instead of dividing by zero. Dropped clients'
+local momentum/error rows carry forward unmodified (the reference's
+per-client-state semantics: a client that never participated cannot have
+mutated its state).
+
+Unbiasedness contract (pinned per mode by tests/test_fedsim.py): a masked
+round with live cohort S equals an unmasked round run with exactly the
+clients in S.
+
+Layering: this package imports ONLY numpy (masks are host-side, like the
+sampler's client draws; they are APPLIED in-graph by ``parallel/``).
+``cfg`` is duck-typed — ``utils.config`` validates against this registry
+via a lazy import, never the other way around.
+
+Default (``availability="always"``, no chaos) traces NOTHING: the round
+builders branch on ``cfg.fedsim_enabled`` at trace time, so the compiled
+program is bit-identical to a fedsim-less build (pinned by the
+``registry_parity.npz`` golden recordings — same discipline as
+``--telemetry_level 0``).
+"""
+
+from commefficient_tpu.fedsim.availability import (
+    available_models,
+    sample_availability,
+)
+from commefficient_tpu.fedsim.env import (
+    FedEnvironment,
+    RoundEnv,
+    build_environment,
+)
+from commefficient_tpu.fedsim.faults import (
+    CHAOS_KINDS,
+    ChaosEvent,
+    parse_chaos,
+    validate_chaos_rounds,
+)
+
+__all__ = [
+    "CHAOS_KINDS",
+    "ChaosEvent",
+    "FedEnvironment",
+    "RoundEnv",
+    "available_models",
+    "build_environment",
+    "parse_chaos",
+    "sample_availability",
+    "validate_chaos_rounds",
+]

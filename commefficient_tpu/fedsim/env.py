@@ -1,0 +1,115 @@
+"""FedEnvironment — availability + chaos composed into per-round masks.
+
+One ``RoundEnv`` per round: the device-side inputs the masked round
+consumes (live mask, corruption mask, live count) plus the host-side
+``fedsim/*`` telemetry scalars that ride the drained metrics pack. Masks
+are numpy (host-side, like the sampler's client draws); the round engines
+apply them IN-GRAPH.
+
+``FederatedSession`` owns one environment (``build_environment(cfg)`` —
+None when ``cfg.fedsim_enabled`` is False) and advances a host round clock
+alongside ``FedState.step``; a checkpoint resume re-syncs the clock, and
+because every mask is a pure function of ``(seed, round_idx)`` the resumed
+run reproduces the uninterrupted one's environment exactly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from commefficient_tpu.fedsim.availability import (
+    round_rng,
+    sample_availability,
+)
+from commefficient_tpu.fedsim.faults import (
+    ChaosEvent,
+    apply_chaos,
+    parse_chaos,
+    validate_chaos_rounds,
+)
+
+
+class RoundEnv(NamedTuple):
+    """One round's realized environment.
+
+    ``live``/``corrupt`` are float32 ``[num_workers]`` 0/1 masks (floats so
+    the round's ``jnp.where`` gates need no casts); ``live_count`` the
+    scalar the server renormalizes by; ``stats`` the host-side ``fedsim/*``
+    scalars (a CONSTANT key set, so the packed metric dicts stay
+    same-keyed across rounds)."""
+
+    live: np.ndarray
+    corrupt: np.ndarray
+    live_count: np.float32
+    stats: dict
+
+
+class FedEnvironment:
+    """The run-long simulator: availability model + parsed chaos plan."""
+
+    def __init__(self, cfg):
+        # duck-typed cfg (utils.config.Config normally) — same discipline
+        # as compress/: this package never imports the config module
+        self.num_workers = int(cfg.num_workers)
+        self.seed = int(cfg.seed)
+        self.availability = cfg.availability
+        self.dropout_prob = float(cfg.dropout_prob)
+        self.period = int(cfg.availability_period)
+        self.num_cohorts = int(cfg.num_cohorts)
+        self.plan: Tuple[ChaosEvent, ...] = parse_chaos(cfg.chaos)
+
+    def describe(self) -> str:
+        bits = [f"availability={self.availability}"]
+        if self.dropout_prob:
+            bits.append(f"dropout_prob={self.dropout_prob:g}")
+        if self.plan:
+            bits.append(f"chaos={len(self.plan)} event(s)")
+        return "fedsim: " + " ".join(bits)
+
+    def validate_rounds(self, num_rounds: int) -> None:
+        """Reject chaos events referencing rounds the run never reaches —
+        callable only where the run length is known (the train entries)."""
+        validate_chaos_rounds(self.plan, num_rounds)
+
+    def round_env(self, round_idx: int) -> RoundEnv:
+        """Realize round ``round_idx``'s masks + telemetry scalars —
+        deterministic and resume-stable from (seed, round_idx)."""
+        W = self.num_workers
+        rng = round_rng(self.seed, round_idx)
+        avail = sample_availability(
+            self.availability, rng, round_idx,
+            num_workers=W, dropout_prob=self.dropout_prob,
+            period=self.period, num_cohorts=self.num_cohorts,
+        )
+        avail, straggler, corrupt = apply_chaos(
+            self.plan, rng, round_idx, avail
+        )
+        live = avail & ~straggler
+        n_live = int(live.sum())
+        stats = {
+            # live participants / num_workers — the ledger derives its
+            # live-byte count from this scalar (exact for any W < 2^23:
+            # the f32 round trip through the metrics pack recovers the
+            # integer by rounding)
+            "fedsim/participation_rate": n_live / W,
+            "fedsim/dropped": float(W - int(avail.sum())),
+            "fedsim/straggler_excluded": float(int((avail & straggler).sum())),
+            "fedsim/all_dropped": float(n_live == 0),
+        }
+        return RoundEnv(
+            live=live.astype(np.float32),
+            corrupt=corrupt.astype(np.float32),
+            live_count=np.float32(n_live),
+            stats=stats,
+        )
+
+
+def build_environment(cfg) -> Optional[FedEnvironment]:
+    """The single construction gate: an environment iff the config turns
+    any masking/chaos source on. None keeps every caller on the untouched
+    fast path (nothing fedsim-related is traced or computed per round)."""
+    if not getattr(cfg, "fedsim_enabled", False):
+        return None
+    return FedEnvironment(cfg)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from commefficient_tpu.compress import compressor_class, get_compressor
+from commefficient_tpu.fedsim import build_environment
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import ravel_params
 from commefficient_tpu.parallel.mesh import (
@@ -157,6 +158,14 @@ class FederatedSession:
         # accounting (bytes_per_round); the round builders construct their
         # own trace-time instances from the same registry.
         self.compressor = get_compressor(cfg, d=self.grad_size, spec=self.spec)
+        # federated environment simulator (fedsim/): None unless the config
+        # turns masking/chaos on — the round builders then trace the masked
+        # aggregation and every train_round consumes one RoundEnv. The host
+        # round clock mirrors FedState.step so the availability schedule is
+        # a pure function of the round index (resume-stable; a checkpoint
+        # restore re-syncs it via sync_round_clock).
+        self.fedsim_env = build_environment(cfg)
+        self._round_clock = 0
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
         if cfg.fsdp:
@@ -266,7 +275,7 @@ class FederatedSession:
         has_aug = augment is not None
         L = self.cfg.round_microbatches  # fedavg [W, L, B/L, ...] convention
 
-        def round_idx_fn(state, data, client_ids, idx, plan, lr):
+        def round_idx_fn(state, data, client_ids, idx, plan, lr, env=()):
             W, B = idx.shape
             flat = idx.reshape(-1)
             batch = {}
@@ -280,11 +289,44 @@ class FederatedSession:
                     k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
                     for k, v in batch.items()
                 }
-            return raw_round(state, client_ids, batch, lr)
+            return raw_round(state, client_ids, batch, lr, env=env)
 
         self._round_idx_fn = jax.jit(round_idx_fn, donate_argnums=(0,))
 
-    def train_round_indices(self, client_ids, idx, plan, lr: float):
+    # -- fedsim (fedsim/: availability masking + chaos) --------------------
+    def sync_round_clock(self) -> None:
+        """Align the host round clock — which drives the fedsim
+        environment's availability/chaos schedule — with FedState.step.
+        Called after a checkpoint restore replaced ``self.state``; a no-op
+        cost otherwise (one scalar fetch, once per restore)."""
+        self._round_clock = int(jax.device_get(self.state.step))
+
+    def _fedsim_round_env(self, env=None):
+        """(device env tuple for round_fn, host ``fedsim/*`` stats) for the
+        CURRENT round — ``((), {})`` when the simulator is inactive.
+        ``env`` (a fedsim.RoundEnv) overrides the session environment's
+        schedule; tests drive explicit masks through it."""
+        if env is None:
+            if self.fedsim_env is None:
+                return (), {}
+            env = self.fedsim_env.round_env(self._round_clock)
+        elif self.fedsim_env is None:
+            # symmetric guard to the round's "fedsim enabled but no env"
+            # error: a session built without fedsim traced NO masking, so
+            # an explicit env would be silently dropped by the round while
+            # its stats still reached the metrics — reject instead
+            raise ValueError(
+                "env= passed but this session was built without fedsim "
+                "(cfg.fedsim_enabled is False — the round traced no "
+                "masking); construct the Config with availability/chaos "
+                "set to drive masked rounds"
+            )
+        live = jax.device_put(jnp.asarray(env.live), self._batch_sharding)
+        corr = jax.device_put(jnp.asarray(env.corrupt), self._batch_sharding)
+        cnt = jax.device_put(jnp.float32(env.live_count), self._replicated)
+        return (live, corr, cnt), dict(env.stats)
+
+    def train_round_indices(self, client_ids, idx, plan, lr: float, env=None):
         """Run one round from device-resident data (see ``attach_data``)."""
         ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
         idxd = jax.device_put(
@@ -298,22 +340,30 @@ class FederatedSession:
             if plan
             else ()
         )
+        fs_env, fs_stats = self._fedsim_round_env(env)
         self.state, metrics = self._round_idx_fn(
-            self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr)
+            self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr),
+            env=fs_env,
         )
-        return metrics
+        self._round_clock += 1
+        return {**metrics, **fs_stats} if fs_stats else metrics
 
     # -- train ------------------------------------------------------------
-    def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray], lr: float):
+    def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray],
+                    lr: float, env=None):
         cids = np.asarray(client_ids)
         ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         dev_batch = jax.tree.map(
             lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding), batch
         )
         lr = jnp.float32(lr)
+        fs_env, fs_stats = self._fedsim_round_env(env)
         if not self.cfg.offload_client_state:
-            self.state, metrics = self.round_fn(self.state, ids, dev_batch, lr)
-            return metrics
+            self.state, metrics = self.round_fn(
+                self.state, ids, dev_batch, lr, env=fs_env
+            )
+            self._round_clock += 1
+            return {**metrics, **fs_stats} if fs_stats else metrics
         vel_rows = (
             jax.device_put(jnp.asarray(self.host_vel[cids]), self._batch_sharding)
             if self.host_vel is not None
@@ -325,13 +375,14 @@ class FederatedSession:
             else ()
         )
         self.state, metrics, new_vel, new_err = self.round_fn(
-            self.state, ids, dev_batch, lr, vel_rows, err_rows
+            self.state, ids, dev_batch, lr, vel_rows, err_rows, env=fs_env
         )
+        self._round_clock += 1
         if self.host_vel is not None:
             self.host_vel[cids] = np.asarray(new_vel)
         if self.host_err is not None:
             self.host_err[cids] = np.asarray(new_err)
-        return metrics
+        return {**metrics, **fs_stats} if fs_stats else metrics
 
     # -- eval -------------------------------------------------------------
     def _put_eval_batch(self, b: Dict[str, np.ndarray]):

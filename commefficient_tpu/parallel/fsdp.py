@@ -231,27 +231,50 @@ def build_fsdp_round_fn(
     m_kind, e_kind = _state_kinds(comp)
     has_m, has_e = m_kind is not None, e_kind is not None
     grad_one = make_grad_one(cfg, loss_fn, unravel, mesh)
+    # fedsim masking is per-client, so it forces the vmap path (round.py)
+    use_fedsim = bool(cfg.fedsim_enabled)
     fused = (
         cfg.fuse_clients
         and cfg.max_grad_norm is None
         and cfg.dp_noise_multiplier == 0
+        and not use_fedsim
     )
 
-    def body(p_sh, m_in, e_in, batch, client_ids, rng, lr):
+    def body(p_sh, m_in, e_in, batch, client_ids, rng, lr, *fs):
+        # fs: (live_mask [w_loc], corrupt [w_loc], live_count) iff fedsim
         # ---- forward/backward on the gathered vector (transient [Dp]) ----
         full = jax.lax.all_gather(p_sh, WORKERS, tiled=True)
         params_vec = full[:d]
+        live_sh = corr_sh = None
+        if use_fedsim:
+            live_sh, corr_sh, live_count = fs
         local, loss_local, aux = sum_client_grads(
-            grad_one, params_vec, batch, client_ids, rng, fused=fused
+            grad_one, params_vec, batch, client_ids, rng, fused=fused,
+            live=live_sh, corrupt=corr_sh,
         )
         loss_mean = jax.lax.psum(loss_local, WORKERS) / W
         aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)
+        if use_fedsim:
+            # renormalize by the live count BEFORE fsdp_update (whose
+            # internal psum/psum_scatter averages by W): scaling the
+            # masked per-device transmit sum is exact by linearity — the
+            # same correction the replicated round applies to its agg.
+            scale = W / jnp.maximum(live_count, 1.0)
+            local = local * scale
+            loss_mean = loss_mean * scale
 
         # ---- sharded server update: the compressor's algebra -------------
         new_p, new_m, new_e = comp.fsdp_update(
             p_sh, m_in, e_in, local, lr,
             axis_name=WORKERS, W=W, d=d, dp=dp, S=S,
         )
+        if use_fedsim:
+            # all-dropped guard: freeze the sharded params + server state
+            # (fedsim/ package docstring; the replicated round's twin)
+            ok = live_count > 0
+            new_p = jnp.where(ok, new_p, p_sh)
+            new_m = jnp.where(ok, new_m, m_in)
+            new_e = jnp.where(ok, new_e, e_in)
 
         # ---- in-graph diagnostics (telemetry/): sharded realization ------
         # Norms come from psum'd shard sq-norms, so no [D] array beyond the
@@ -312,19 +335,32 @@ def build_fsdp_round_fn(
     m_spec = (P(WORKERS) if m_kind == KIND_DENSE else P())
     e_spec = (P(WORKERS) if e_kind == KIND_DENSE else P())
     shard = P(WORKERS)
+    in_specs = (shard, m_spec, e_spec, shard, shard, P(), P())
+    if use_fedsim:
+        in_specs = in_specs + (shard, shard, P())  # live, corrupt, count
     mapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(shard, m_spec, e_spec, shard, shard, P(), P()),
+        in_specs=in_specs,
         out_specs=(shard, m_spec, e_spec, P(), P(), P()),
     )
 
-    def round_fn(state: FedState, client_ids, batch, lr):
+    def round_fn(state: FedState, client_ids, batch, lr, env=()):
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
+        fs = ()
+        if use_fedsim:
+            if not env:
+                raise ValueError(
+                    "fedsim is enabled (cfg.fedsim_enabled) but no env was "
+                    "passed — supply env=(live_mask [W], corrupt [W], "
+                    "live_count) from FedEnvironment.round_env "
+                    "(FederatedSession.train_round does this)"
+                )
+            fs = tuple(env)
         m = state.momentum if has_m else jnp.zeros((nsh,), f32)
         e = state.error if has_e else jnp.zeros((nsh,), f32)
         new_p, new_m, new_e, loss, aux, diag = mapped(
-            state.params_vec, m, e, batch, client_ids, rng, lr
+            state.params_vec, m, e, batch, client_ids, rng, lr, *fs
         )
         new_state = FedState(
             params_vec=new_p,

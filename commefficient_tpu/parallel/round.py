@@ -165,13 +165,20 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
     return grad_one
 
 
-def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *, fused: bool):
+def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *,
+                     fused: bool, live=None, corrupt=None):
     """(sum of client grads [D], loss sum, aux sum) over one shard's clients
     — the NO-client-state aggregation shared by the replicated round's fused
     fast path and the FSDP round (parallel/fsdp.py), extracted so the two
     cannot drift. ``fused``: one flattened-batch grad replaces the per-client
     vmap — identical math when nothing per-client is configured
-    (w_loc * flat-mean-grad == sum of per-client mean-grads)."""
+    (w_loc * flat-mean-grad == sum of per-client mean-grads).
+
+    ``live``/``corrupt`` ([w_loc] 0/1 floats, fedsim masked aggregation —
+    FSDP path only; the round builders disable fusion whenever fedsim is
+    on, since a flattened batch has no per-client terms to mask): masked
+    clients contribute NOTHING (``jnp.where``, so a zero mask also blocks a
+    corrupted NaN), corrupted LIVE clients inject a non-finite payload."""
     w_loc = client_ids.shape[0]
     if fused:
         flat = jax.tree.map(
@@ -185,6 +192,16 @@ def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *, fused: boo
         return grad_one(params_vec, b, jax.random.fold_in(rng, cid))
 
     gs, losses, auxes = jax.vmap(per_client)(batch, client_ids)
+    if live is not None:
+        ext = lambda m, a: m.reshape(m.shape + (1,) * (a.ndim - 1))  # noqa: E731
+        # corruption first, mask second: a zero mask blocks even a
+        # corrupted payload's NaN (same ordering as worker_shard's
+        # per_client — only a LIVE corrupted client poisons the aggregate)
+        if corrupt is not None:
+            gs = jnp.where(ext(corrupt, gs) > 0, jnp.float32(jnp.nan), gs)
+        gs = jnp.where(ext(live, gs) > 0, gs, 0.0)
+        losses = losses * live
+        auxes = jax.tree.map(lambda a: a * ext(live, a), auxes)
     return (
         jnp.sum(gs, axis=0),
         jnp.sum(losses),
@@ -242,9 +259,16 @@ def build_round_fn(
 
     lm = cfg.local_momentum
 
+    # fedsim masked aggregation (fedsim/ package): a PYTHON-level gate like
+    # cfg.telemetry_level — when off, nothing below is traced and the
+    # compiled round is bit-identical to a pre-fedsim program (golden
+    # parity recordings pin it).
+    use_fedsim = bool(cfg.fedsim_enabled)
+
     # fused-clients fast path (cfg.fuse_clients): one flattened-batch grad
     # replaces the per-client vmap — identical math when nothing per-client
     # is configured (sum of per-client mean-grads == w_loc * flat mean-grad).
+    # fedsim masking is inherently per-client, so it forces the vmap path.
     fused = (
         cfg.fuse_clients
         and comp.supports_fused_clients
@@ -252,11 +276,14 @@ def build_round_fn(
         and cfg.error_type != "local"
         and cfg.max_grad_norm is None
         and cfg.dp_noise_multiplier == 0
+        and not use_fedsim
     )
 
     # ---- the shard body: this IS the worker process ----------------------
-    def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng, lr):
-        # batch: one shard's {k: [w_loc, ...]}; vel/err: [w_loc, D] or ()
+    def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng,
+                     lr, *fs):
+        # batch: one shard's {k: [w_loc, ...]}; vel/err: [w_loc, D] or ();
+        # fs: (live_mask [w_loc], corrupt [w_loc]) iff use_fedsim
         #
         # pcast(to="varying") is load-bearing: under shard_map's vma
         # semantics, differentiating w.r.t. a REPLICATED input auto-inserts a
@@ -267,7 +294,7 @@ def build_round_fn(
         # happens exactly once, at the explicit psum.
         params_vec = pcast(params_vec, WORKERS, to="varying")
 
-        def per_client(b, cid, vel, err):
+        def per_client(b, cid, vel, err, m=None, c=None):
             noise_rng = jax.random.fold_in(rng, cid)
             g, loss, aux = comp.client_grad(
                 grad_one, params_vec, b, noise_rng, lr
@@ -280,6 +307,25 @@ def build_round_fn(
             # each device encodes ONCE below instead of per client (8x
             # fewer sketches per chip; ICI still carries only the encoding).
             transmit, new_vel, new_err = comp.client_transmit(u, err, lr)
+            if use_fedsim:
+                # masked aggregation (fedsim/): chaos corruption NaNs a
+                # client's payload FIRST (so the flight-recorder/
+                # DivergenceError path is exercised end-to-end), then the
+                # live mask zeroes every non-participant's transmit —
+                # jnp.where, not multiply, so a zero mask blocks even a
+                # corrupted payload's NaN (0 * nan == nan): only a LIVE
+                # corrupted client can poison the aggregate. A masked-out
+                # client's local momentum/error rows carry forward
+                # unmodified (it never participated; reference per-client-
+                # state semantics).
+                transmit = jnp.where(c > 0, jnp.float32(jnp.nan), transmit)
+                transmit = jnp.where(m > 0, transmit, 0.0)
+                loss = loss * m
+                aux = jax.tree.map(lambda a: a * m, aux)
+                if lm > 0:
+                    new_vel = jnp.where(m > 0, new_vel, vel)
+                if cfg.error_type == "local":
+                    new_err = jnp.where(m > 0, new_err, err)
             return transmit, new_vel, new_err, loss, aux
 
         w_loc = client_ids.shape[0]
@@ -294,8 +340,10 @@ def build_round_fn(
             errs = err_rows if cfg.error_type == "local" else jnp.zeros(
                 (w_loc, 1), f32
             )
+            # fs is (live, corrupt) under fedsim, () otherwise — per_client
+            # defaults m/c to None, so one call site serves both traces
             transmit, new_vel, new_err, loss, aux = jax.vmap(per_client)(
-                batch, client_ids, vels, errs
+                batch, client_ids, vels, errs, *fs
             )
             local = jnp.sum(transmit, axis=0)
             loss_local = jnp.sum(loss)
@@ -307,15 +355,30 @@ def build_round_fn(
         return agg, loss_mean, aux_sum, new_vel, new_err
 
     shard_spec = P(WORKERS)
+    in_specs = (P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P())
+    if use_fedsim:
+        in_specs = in_specs + (shard_spec, shard_spec)  # live mask, corrupt
     worker_mapped = shard_map(
         worker_shard,
         mesh=mesh,
-        in_specs=(P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P()),
+        in_specs=in_specs,
         out_specs=(P(), P(), P(), shard_spec, shard_spec),
     )
 
-    def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(), err_rows=()):
+    def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(),
+                 err_rows=(), env=()):
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
+        fs = ()
+        if use_fedsim:
+            if not env:
+                raise ValueError(
+                    "fedsim is enabled (cfg.fedsim_enabled) but no env was "
+                    "passed — supply env=(live_mask [W], corrupt [W], "
+                    "live_count) from FedEnvironment.round_env "
+                    "(FederatedSession.train_round does this)"
+                )
+            live_mask, corrupt, live_count = env
+            fs = (live_mask, corrupt)
         if not cfg.offload_client_state:
             vel_rows = (
                 state.client_vel[client_ids] if lm > 0 else jnp.zeros((W, 1), f32)
@@ -331,14 +394,43 @@ def build_round_fn(
             if not needs_client_err(cfg):
                 err_rows = jnp.zeros((W, 1), f32)
         agg, loss, aux, new_vel, new_err = worker_mapped(
-            state.params_vec, batch, client_ids, vel_rows, err_rows, rng, lr
+            state.params_vec, batch, client_ids, vel_rows, err_rows, rng, lr,
+            *fs
         )
+        if use_fedsim:
+            # renormalize by the LIVE count: the shard body averaged the
+            # psum by W with the dead clients' terms zeroed, and every
+            # device_encode is linear (compress/ psum-safety contract), so
+            # the scalar correction commutes with the encode for all modes
+            # — a masked round with live cohort S equals an unmasked round
+            # over exactly S (tests/test_fedsim.py). The max(live, 1)
+            # guard keeps an all-dropped round finite; its whole server
+            # update is frozen below.
+            scale = W / jnp.maximum(live_count, 1.0)
+            agg = agg * scale
+            loss = loss * scale  # loss becomes the mean over LIVE clients
         # ---- server update (fed_aggregator _server_helper_* ~L380-540):
         # the compressor's momentum/error algebra + update extraction,
         # returning the APPLIED delta (w -= delta)
         delta, new_m, new_e, new_comp = comp.server_update(
             state.momentum, state.error, state.comp, agg, lr, state.step
         )
+        if use_fedsim:
+            # all-clients-dropped guard: nothing arrived, so nothing may
+            # move — params freeze and every server-state leaf (momentum/
+            # error/compressor-private) carries forward; the host-side
+            # fedsim/all_dropped sentinel rides the metrics instead of a
+            # 0/0 poisoning the run
+            ok = live_count > 0
+            delta = jnp.where(ok, delta, 0.0)
+
+            def keep(new, old):
+                return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                    new, old)
+
+            new_m = keep(new_m, state.momentum)
+            new_e = keep(new_e, state.error)
+            new_comp = keep(new_comp, state.comp)
         if cfg.do_topk_down and comp.dense_delta:
             # downlink compression (reference down-compression flag): the
             # broadcast weight delta is itself top-k sparsified, so the

@@ -53,8 +53,11 @@ from commefficient_tpu.telemetry.flight import (
 from commefficient_tpu.telemetry.ledger import CommLedger, run_metadata
 
 # versioned schema shared by metrics.jsonl headers, flight_*.json and
-# comm_ledger.json (scripts/check_telemetry_schema.py validates against it)
-SCHEMA_VERSION = 1
+# comm_ledger.json (scripts/check_telemetry_schema.py validates against it).
+# v2 (fedsim PR): fedsim/* scalar namespace, the ledger's masked live-byte
+# accounting (live_client_rounds/avail_client_rounds + their exactness
+# invariant), and the flight dump's participation_history window.
+SCHEMA_VERSION = 2
 
 TELEMETRY_LEVELS = (0, 1, 2)
 
@@ -66,8 +69,13 @@ def build_telemetry_riders(cfg, session, writer):
     ``bytes_per_round()``, ``grad_size``, ``mesh``)."""
     if getattr(cfg, "telemetry_level", 0) < 1 or writer is None:
         return None, None
+    # fedsim runs switch the ledger to masked live-byte accounting: only
+    # live clients' uplink counts, through the compressor's mask-aware
+    # accounting hook (compress/base.masked_upload_floats)
     ledger = CommLedger(session.bytes_per_round(), mode=cfg.mode,
-                        num_workers=cfg.num_workers)
+                        num_workers=cfg.num_workers,
+                        masked=bool(getattr(cfg, "fedsim_enabled", False)),
+                        compressor=getattr(session, "compressor", None))
     flight = FlightRecorder(
         cfg, logdir=writer.logdir,
         extra_meta={"grad_size": session.grad_size,

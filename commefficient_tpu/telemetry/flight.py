@@ -146,16 +146,29 @@ class FlightRecorder:
 
         os.makedirs(self.logdir, exist_ok=True)
         path = os.path.join(self.logdir, f"flight_{int(step)}.json")
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "reason": reason,
+            "first_bad_step": first_bad_step,
+            "window": self.window,
+            "meta": self.meta,
+            "records": list(self.records),
+        }
+        # fedsim runs: surface the participation trajectory directly —
+        # "did the cohort thin out before the blow-up?" is the first
+        # question a partial-participation post-mortem asks, so the
+        # [step, participation_rate] window rides the dump top-level
+        # instead of being fished out of per-record scalars
+        hist = [
+            [r["step"], r["scalars"]["fedsim/participation_rate"]]
+            for r in self.records
+            if "fedsim/participation_rate" in r.get("scalars", {})
+        ]
+        if hist:
+            payload["participation_history"] = hist
         with open(path, "w") as f:
             json.dump(
-                jsonable_tree({
-                    "schema_version": SCHEMA_VERSION,
-                    "reason": reason,
-                    "first_bad_step": first_bad_step,
-                    "window": self.window,
-                    "meta": self.meta,
-                    "records": list(self.records),
-                }),
+                jsonable_tree(payload),
                 f,
                 indent=2,
                 allow_nan=False,

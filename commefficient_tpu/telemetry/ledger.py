@@ -62,27 +62,67 @@ def run_metadata(cfg=None, extra: Optional[dict] = None) -> dict:
 class CommLedger:
     """Exact uplink/downlink byte accounting over the drained rounds.
 
-    ``on_round(step)`` is called once per DRAINED round (drain order ==
-    step order) and returns the scalars to emit at that step; ``write``
-    persists the summary. Constructed by the train loops at
+    ``on_round(step, scalars)`` is called once per DRAINED round (drain
+    order == step order) and returns the scalars to emit at that step;
+    ``write`` persists the summary. Constructed by the train loops at
     ``telemetry_level >= 1`` from ``session.bytes_per_round()`` — the same
     numbers the session prints at startup, so the ledger can never drift
     from the accounting the compressor declares.
+
+    fedsim masked accounting (``masked=True``, set iff the run's
+    ``cfg.fedsim_enabled``): only LIVE clients transmitted, so the round's
+    uplink is the live count x the per-client payload (through the
+    compressor's ``masked_upload_floats`` hook when one is supplied — the
+    hook, not this class, owns the every-mode-is-linear claim), and the
+    downlink counts every AVAILABLE client (stragglers downloaded params
+    before missing the deadline; dropped clients never joined). The
+    exactness invariant becomes ``cum_up_bytes == live_client_rounds x
+    upload_bytes`` with ``live_client_rounds = sum of live_i`` — enforced
+    by scripts/check_telemetry_schema.py. Live/avail counts are recovered
+    from the drained ``fedsim/*`` scalars riding the same metric dict, so
+    the ledger can never disagree with what the run logged.
     """
 
     def __init__(self, bytes_per_round: Dict[str, int], *, mode: str,
-                 num_workers: int):
+                 num_workers: int, masked: bool = False, compressor=None):
         self.bytes_per_round = {k: int(v) for k, v in bytes_per_round.items()}
         self.mode = mode
         self.num_workers = int(num_workers)
+        self.masked = bool(masked)
+        self._comp = compressor  # duck-typed: masked_upload_floats(live)
         self.rounds = 0
         self.cum_up_bytes = 0
         self.cum_down_bytes = 0
+        self.live_client_rounds = 0
+        self.avail_client_rounds = 0
 
-    def on_round(self, step: int) -> Dict[str, float]:
-        """Account one drained round; returns this step's comm/* scalars."""
+    def _counts(self, scalars: Optional[Dict[str, float]]):
+        """(live, avail) client counts for one drained round, recovered
+        from the fedsim/* scalars (exact: live/W round-trips f32 losslessly
+        enough to re-round for any real W). Missing scalars mean full
+        participation — a masked ledger stays consistent even if a run
+        mixes in fedsim-less rounds."""
+        W = self.num_workers
+        scalars = scalars or {}
+        rate = scalars.get("fedsim/participation_rate")
+        live = W if rate is None else int(round(float(rate) * W))
+        avail = W - int(round(float(scalars.get("fedsim/dropped", 0.0))))
+        return live, avail
+
+    def on_round(self, step: int,
+                 scalars: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        """Account one drained round; returns this step's comm/* scalars.
+        ``scalars`` is the round's drained metric dict (the fedsim/*
+        participation scalars live there); ignored unless ``masked``."""
         up = self.bytes_per_round["upload_bytes"]
         down = self.bytes_per_round["download_bytes"]
+        if self.masked:
+            live, avail = self._counts(scalars)
+            up = (4 * self._comp.masked_upload_floats(live)
+                  if self._comp is not None else live * up)
+            down = avail * down
+            self.live_client_rounds += live
+            self.avail_client_rounds += avail
         self.rounds += 1
         self.cum_up_bytes += up
         self.cum_down_bytes += down
@@ -97,7 +137,7 @@ class CommLedger:
     def summary(self) -> dict:
         from commefficient_tpu.telemetry import SCHEMA_VERSION
 
-        return {
+        out = {
             "schema_version": SCHEMA_VERSION,
             "mode": self.mode,
             "num_workers": self.num_workers,
@@ -107,6 +147,13 @@ class CommLedger:
             "cum_down_bytes": self.cum_down_bytes,
             "cum_bytes": self.cum_up_bytes + self.cum_down_bytes,
         }
+        if self.masked:
+            # fedsim live-byte invariant (checker-enforced):
+            #   cum_up_bytes == live_client_rounds * upload_bytes
+            #   cum_down_bytes == avail_client_rounds * download_bytes
+            out["live_client_rounds"] = self.live_client_rounds
+            out["avail_client_rounds"] = self.avail_client_rounds
+        return out
 
     def write(self, logdir: str) -> str:
         """Write ``comm_ledger.json`` into the run dir; returns the path."""

@@ -162,8 +162,16 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     With ``checkpointer`` (utils.checkpoint.FedCheckpointer) the loop honors
     ``cfg.checkpoint_every``/``cfg.resume``: a resumed run fast-forwards to
     the checkpointed round (sampler + lr schedule are pure functions of the
-    step, so this reproduces the uninterrupted run exactly)."""
+    step, so this reproduces the uninterrupted run exactly — including the
+    fedsim environment's availability/chaos realization, which keys off the
+    same round clock)."""
     steps_per_epoch = sampler.steps_per_epoch()
+    if session.fedsim_env is not None:
+        # chaos round indices can only be checked against the run length
+        # here — Config cannot know steps_per_epoch (it derives from the
+        # dataset size)
+        session.fedsim_env.validate_rounds(steps_per_epoch * cfg.num_epochs)
+        print(session.fedsim_env.describe())
     lr_fn = partial(
         piecewise_linear_lr,
         steps_per_epoch=steps_per_epoch,

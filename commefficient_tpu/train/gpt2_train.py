@@ -133,6 +133,12 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     (gpt2_train.py ~L280-360). Honors checkpoint_every/resume like
     cv_train.train_loop."""
     steps_per_epoch = sampler.steps_per_epoch()
+    if session.fedsim_env is not None:
+        # chaos round indices can only be checked against the run length
+        # here — Config cannot know steps_per_epoch (it derives from the
+        # dataset size)
+        session.fedsim_env.validate_rounds(steps_per_epoch * cfg.num_epochs)
+        print(session.fedsim_env.describe())
     lr_fn = partial(
         piecewise_linear_lr,
         steps_per_epoch=steps_per_epoch,

@@ -247,6 +247,10 @@ class FedCheckpointer:
             session.host_vel = np.asarray(restored["host_vel"])
         if "host_err" in restored:
             session.host_err = np.asarray(restored["host_err"])
+        # the fedsim availability/chaos schedule keys off a host round
+        # clock mirroring FedState.step — re-sync it so a resumed run
+        # realizes the SAME masks the uninterrupted run would have
+        session.sync_round_clock()
         return int(np.asarray(fs["step"]))
 
     def close(self):

@@ -20,6 +20,9 @@ from typing import Optional
 MODES = ("uncompressed", "sketch", "true_topk", "local_topk", "fedavg",
          "powersgd")
 ERROR_TYPES = ("none", "local", "virtual")
+# mirrors the fedsim/ availability registry (fedsim.available_models);
+# pinned equal by tests/test_fedsim.py — same no-cycle pattern as MODES
+AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "sine")
 
 
 @dataclass(frozen=True)
@@ -240,6 +243,39 @@ class Config:
     # (telemetry/flight.py). Active at telemetry_level >= 1.
     flight_window: int = 16
 
+    # --- federated environment simulation (commefficient_tpu/fedsim/;
+    # TPU-native — the reference assumes all num_workers arrive every
+    # round) ---
+    # Availability model emitting the per-round [num_workers] participation
+    # mask from (round_idx, seed): "always" (default — nothing fedsim is
+    # traced, the round stays bit-identical to a pre-fedsim build, same
+    # discipline as --telemetry_level 0), "bernoulli" (iid per-client
+    # dropout at dropout_prob), "sine" (diurnal: drop prob oscillates
+    # 0..dropout_prob over availability_period rounds), "cohort"
+    # (correlated outages: num_cohorts slot groups, each fully out with
+    # prob dropout_prob). Masked clients transmit NOTHING and the server
+    # renormalizes by the live count (fedsim/ package docstring).
+    availability: str = "always"
+    # Per-client drop probability (bernoulli), peak drop probability
+    # (sine), or per-cohort outage probability (cohort). Must be in
+    # [0, 1): 1.0 would drop every client every round and nothing would
+    # ever train (a single all-dropped round is survivable — the guard
+    # freezes params and flags fedsim/all_dropped — but a certainty of it
+    # is a config error).
+    dropout_prob: float = 0.0
+    availability_period: int = 64  # sine period (rounds per diurnal cycle)
+    num_cohorts: int = 4  # cohort model: slot i belongs to cohort i % n
+    # Scheduled chaos plan (fedsim/faults.py grammar): comma-separated
+    # "kind@value[:rounds=A-B]" with kinds dropout (extra iid dropout),
+    # straggler (deadline miss: excluded from aggregation + ledger live
+    # bytes, local state untouched), nan_client (corrupt one live client's
+    # payload at round value — proves the flight-recorder/DivergenceError
+    # path; DETECTION needs telemetry_level >= 1). Example:
+    # "dropout@0.3:rounds=50-100,nan_client@120". Syntax validated here;
+    # round indices are validated against the run length at train-entry
+    # time (Config cannot know steps_per_epoch).
+    chaos: str = ""
+
     # --- misc (reference: --seed; the mesh-shape flags above are ours) ---
     seed: int = 42
     checkpoint_dir: str = ""
@@ -331,8 +367,47 @@ class Config:
         if self.num_workers % self.num_devices != 0:
             raise ValueError(
                 "num_workers must be divisible by num_devices "
-                f"({self.num_workers} % {self.num_devices} != 0)"
+                f"({self.num_workers} % {self.num_devices} != 0). If you "
+                "were resizing num_workers to model PARTIAL PARTICIPATION, "
+                "don't — keep the round shape fixed and mask clients out "
+                "with the fedsim environment instead (--availability "
+                "bernoulli --dropout_prob p, or --chaos 'dropout@p'); "
+                "masked clients transmit nothing and the server "
+                "renormalizes by the live count"
             )
+        if self.availability not in AVAILABILITY_MODELS:
+            raise ValueError(
+                f"availability must be one of {AVAILABILITY_MODELS}, got "
+                f"{self.availability!r}"
+            )
+        if not 0.0 <= self.dropout_prob < 1.0:
+            raise ValueError(
+                f"dropout_prob must be in [0, 1), got {self.dropout_prob} "
+                "(at 1.0 every client drops every round and nothing ever "
+                "trains)"
+            )
+        if self.dropout_prob > 0 and self.availability == "always":
+            raise ValueError(
+                "dropout_prob > 0 has no effect with availability="
+                "'always'; pick a model that uses it (bernoulli|sine|"
+                "cohort), or schedule it via --chaos 'dropout@p'"
+            )
+        if self.availability_period < 1:
+            raise ValueError(
+                f"availability_period must be >= 1, got "
+                f"{self.availability_period}"
+            )
+        if self.num_cohorts < 1:
+            raise ValueError(
+                f"num_cohorts must be >= 1, got {self.num_cohorts}"
+            )
+        if self.chaos:
+            # syntax + range validation (ValueError with the grammar);
+            # lazy import keeps the no-cycle layering (fedsim never
+            # imports config)
+            from commefficient_tpu.fedsim.faults import parse_chaos
+
+            parse_chaos(self.chaos)
         if self.model_axis < 1 or self.seq_axis < 1:
             raise ValueError(
                 f"model_axis/seq_axis must be >= 1, got "
@@ -353,6 +428,14 @@ class Config:
     @property
     def clients_per_device(self) -> int:
         return self.num_workers // self.num_devices
+
+    @property
+    def fedsim_enabled(self) -> bool:
+        """True when the federated-environment simulator must be threaded
+        through the jitted round (any masking/chaos source is on). False
+        keeps the round trace IDENTICAL to a fedsim-less build — the
+        golden parity recordings pin that (fedsim/ package docstring)."""
+        return self.availability != "always" or bool(self.chaos)
 
     @property
     def sampler_batch_size(self) -> int:

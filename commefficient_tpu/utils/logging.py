@@ -237,7 +237,10 @@ def drain_round_metrics(pending, writer, accumulate, ledger=None,
                 for k in names:
                     if "/" in k:
                         writer.scalar(k, float(metrics[k]), s)
-            comm = ledger.on_round(s) if ledger is not None else {}
+            # the round's metric dict rides along: a fedsim-masked ledger
+            # recovers the live/avail client counts from its fedsim/*
+            # scalars (telemetry/ledger.py masked accounting)
+            comm = ledger.on_round(s, metrics) if ledger is not None else {}
             if writer:
                 for k, v in comm.items():
                     writer.scalar(k, v, s)

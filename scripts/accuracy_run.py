@@ -46,6 +46,19 @@ def main():
                          "bit-identical round")
     ap.add_argument("--logdir", default="runs",
                     help="root for the per-run metrics/ledger/flight dirs")
+    ap.add_argument("--dropout", type=float, default=None,
+                    help="fedsim bernoulli per-client dropout probability "
+                         "applied to EVERY run: masked clients transmit "
+                         "nothing, the server renormalizes by the live "
+                         "count, and the ledger counts only live-client "
+                         "bytes. NB masked runs log comm/* in FLEET bytes "
+                         "(live x per-client), not the classic per-client-"
+                         "link units — so for comparable 0%% vs 30%% "
+                         "loss-vs-bytes curves run BOTH points through "
+                         "this flag (--dropout 0.0 keeps full "
+                         "participation but switches to the same fleet "
+                         "accounting). Omit the flag entirely for the "
+                         "classic per-client table.")
     args = ap.parse_args()
 
     from commefficient_tpu.telemetry import DivergenceError
@@ -65,6 +78,13 @@ def main():
         synthetic_variant=args.variant,
         telemetry_level=args.telemetry_level, logdir=args.logdir,
     )
+    if args.dropout is not None:
+        # fedsim partial participation for the whole table (masking forces
+        # the per-client vmap path; fuse_clients flags below are ignored).
+        # An EXPLICIT --dropout 0.0 still enables the environment so the
+        # ledger uses the same fleet live-byte units as the lossy runs —
+        # that is what makes the 0%-vs-30% loss-vs-bytes comparison valid.
+        base.update(availability="bernoulli", dropout_prob=args.dropout)
     k = 50_000
     # Per-mode (lr_scale, pivot_epoch), tuned by scripts/r3_sweep.py — the
     # FetchSGD paper tunes lr per compression config the same way (§5).
@@ -159,10 +179,10 @@ def main():
         finally:
             writer.close()
         dt = time.time() - t0
-        rows.append((name, cfg.lr_scale, cfg.pivot_epoch,
+        rows.append((name, cfg.lr_scale, cfg.pivot_epoch, cfg.dropout_prob,
                      bpr["upload_bytes"], bpr["download_bytes"],
                      val.get("accuracy", float("nan")), val["loss"], dt))
-        print(f"== {name}: acc={rows[-1][5]:.4f} upload={bpr['upload_bytes']:,}B "
+        print(f"== {name}: acc={rows[-1][6]:.4f} upload={bpr['upload_bytes']:,}B "
               f"({dt:.0f}s)", flush=True)
         _write(args, base, k, rows, real, pre_rows)  # incremental
 
@@ -183,13 +203,24 @@ def _write(args, base, k, rows, real, pre_rows=()):
         "r x c split (identical table bytes). Produced by "
         "`python scripts/accuracy_run.py` on one TPU v5e chip.",
         "",
-        "| mode | lr (peak) | pivot ep | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
-        "|---|---|---|---|---|---|---|---|",
+        "| mode | lr (peak) | pivot ep | dropout | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
+    ncols = lines[-2].count("|")
+    for r in pre_rows:
+        if r.count("|") != ncols:
+            # --skip carries rows verbatim from the existing file; a row
+            # written under an older column layout (e.g. pre-dropout-column)
+            # would silently shift every cell — refuse instead
+            raise SystemExit(
+                f"--skip row has {r.count('|') - 1} columns, current table "
+                f"has {ncols - 1} (the layout changed since that file was "
+                f"written — rerun without --skip): {r}"
+            )
     lines.extend(pre_rows)
-    for name, lr, pv, up, down, acc, loss, dt in rows:
+    for name, lr, pv, drop, up, down, acc, loss, dt in rows:
         lines.append(
-            f"| {name} | {lr} | {pv} | {up:,} | {down:,} | "
+            f"| {name} | {lr} | {pv} | {drop:g} | {up:,} | {down:,} | "
             f"{acc:.4f} | {loss:.4f} | {dt:.0f} |"
         )
     lines += [

@@ -8,9 +8,14 @@ The telemetry subsystem writes three artifact kinds per run dir
                             records ``{"name", "value", "step", "t"}``
   * ``comm_ledger.json``  — cumulative communication accounting; the
                             cumulative bytes must equal
-                            ``rounds * bytes_per_round`` EXACTLY
+                            ``rounds * bytes_per_round`` EXACTLY — or, for
+                            fedsim masked runs (live_client_rounds /
+                            avail_client_rounds present), the live-byte
+                            sums ``live_client_rounds * upload_bytes`` /
+                            ``avail_client_rounds * download_bytes``
   * ``flight_<step>.json``— divergence/crash flight record: metadata +
                             ring-buffered round records in step order
+                            (+ the fedsim participation_history window)
 
 Consumers (plotting, run comparison, the driver's ACCURACY tooling) parse
 these blind, so the writers and this checker are pinned to each other by
@@ -28,11 +33,14 @@ import json
 import sys
 from pathlib import Path
 
-KNOWN_SCHEMA_VERSIONS = (1,)
+# v2 (fedsim PR): fedsim/* scalar namespace, ledger masked live-byte
+# accounting (live_client_rounds/avail_client_rounds + exactness
+# invariant), flight participation_history; v1 artifacts stay valid
+KNOWN_SCHEMA_VERSIONS = (1, 2)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
-SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/")
+SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/")
 
 
 class SchemaError(ValueError):
@@ -157,8 +165,15 @@ def validate_metrics_jsonl(path) -> int:
 
 
 def validate_comm_ledger(path) -> dict:
-    """Validate comm_ledger.json INCLUDING the exactness invariant:
-    cumulative bytes == rounds * bytes_per_round."""
+    """Validate comm_ledger.json INCLUDING the exactness invariant.
+
+    Full-participation ledgers: cumulative bytes == rounds *
+    bytes_per_round. fedsim masked ledgers (the ``live_client_rounds`` /
+    ``avail_client_rounds`` keys present): only live clients' uplink and
+    available clients' downlink counted, so the invariant becomes
+    ``cum_up_bytes == live_client_rounds * upload_bytes`` (with
+    live_client_rounds = sum over rounds of that round's live count) and
+    likewise for the downlink — exact ints, no tolerance."""
     where = str(path)
     with open(path) as f:
         rec = _strict_loads(f.read())
@@ -177,15 +192,36 @@ def validate_comm_ledger(path) -> dict:
     up = _req(rec, "cum_up_bytes", int, where)
     down = _req(rec, "cum_down_bytes", int, where)
     total = _req(rec, "cum_bytes", int, where)
-    if up != rounds * bpr["upload_bytes"]:
+    masked = "live_client_rounds" in rec or "avail_client_rounds" in rec
+    if masked:
+        live = _req(rec, "live_client_rounds", int, where)
+        avail = _req(rec, "avail_client_rounds", int, where)
+        if not 0 <= live <= rounds * nw:
+            raise SchemaError(
+                f"{where}: live_client_rounds {live} outside "
+                f"[0, rounds * num_workers] ({rounds} * {nw})"
+            )
+        if not live <= avail <= rounds * nw:
+            raise SchemaError(
+                f"{where}: avail_client_rounds {avail} outside "
+                f"[live_client_rounds, rounds * num_workers]"
+            )
+        up_want, down_want = (live * bpr["upload_bytes"],
+                              avail * bpr["download_bytes"])
+        up_law = "live_client_rounds * upload_bytes"
+        down_law = "avail_client_rounds * download_bytes"
+    else:
+        up_want, down_want = (rounds * bpr["upload_bytes"],
+                              rounds * bpr["download_bytes"])
+        up_law = "rounds * upload_bytes"
+        down_law = "rounds * download_bytes"
+    if up != up_want:
         raise SchemaError(
-            f"{where}: cum_up_bytes {up} != rounds * upload_bytes "
-            f"({rounds} * {bpr['upload_bytes']})"
+            f"{where}: cum_up_bytes {up} != {up_law} ({up_want})"
         )
-    if down != rounds * bpr["download_bytes"]:
+    if down != down_want:
         raise SchemaError(
-            f"{where}: cum_down_bytes {down} != rounds * download_bytes "
-            f"({rounds} * {bpr['download_bytes']})"
+            f"{where}: cum_down_bytes {down} != {down_law} ({down_want})"
         )
     if total != up + down:
         raise SchemaError(f"{where}: cum_bytes {total} != up + down")
@@ -212,6 +248,21 @@ def validate_flight(path) -> dict:
             f"{where}: {len(records)} records exceed the ring window "
             f"{window}"
         )
+    if "participation_history" in rec:
+        # fedsim runs: the [step, participation_rate] window surfaced
+        # top-level by FlightRecorder.dump
+        hist = _req(rec, "participation_history", list, where)
+        if len(hist) > window:
+            raise SchemaError(
+                f"{where}: participation_history exceeds the ring window"
+            )
+        for j, pair in enumerate(hist):
+            w = f"{where}:participation_history[{j}]"
+            if (not isinstance(pair, list) or len(pair) != 2
+                    or isinstance(pair[0], bool)
+                    or not isinstance(pair[0], int)):
+                raise SchemaError(f"{w}: expected [step, rate] pair")
+            _check_scalar_value(pair[1], "fedsim/participation_rate", w)
     last = None
     for j, r in enumerate(records):
         w = f"{where}:records[{j}]"

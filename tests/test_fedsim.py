@@ -1,0 +1,537 @@
+"""fedsim/ — availability models, chaos plans, and masked-round algebra.
+
+The load-bearing pin is UNBIASEDNESS: a masked round with live cohort S
+must equal (atol 1e-6) an unmasked round run with exactly the clients in
+S, for every registered compression mode — masking commutes with every
+``device_encode`` because the encode is linear (the compress/ psum-safety
+contract) and the server renormalizes by the live count. Kept on the
+TinyMLP task (no d=6.6M sketches on CPU — tier-1 budget).
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+from test_round import BASE, _final_vec, _setup
+
+from commefficient_tpu.fedsim import (
+    ChaosEvent,
+    available_models,
+    build_environment,
+    parse_chaos,
+    validate_chaos_rounds,
+)
+from commefficient_tpu.fedsim.env import FedEnvironment, RoundEnv
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils.config import AVAILABILITY_MODELS, Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schema_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(REPO, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# availability models
+# ---------------------------------------------------------------------------
+
+def test_availability_registry_matches_config_tuple():
+    """config.AVAILABILITY_MODELS mirrors the fedsim registry (the no-cycle
+    pattern MODES uses for compress/)."""
+    assert tuple(sorted(AVAILABILITY_MODELS)) == available_models()
+
+
+def _env(**kw):
+    defaults = dict(num_workers=8, num_clients=16, seed=7,
+                    availability="bernoulli", dropout_prob=0.4,
+                    availability_period=16, num_cohorts=4, chaos="")
+    defaults.update(kw)
+    return FedEnvironment(Config(**defaults))
+
+
+@pytest.mark.parametrize("model", sorted(AVAILABILITY_MODELS))
+def test_masks_deterministic_and_resume_stable(model):
+    """Masks are pure functions of (seed, round_idx): two independently
+    constructed environments (a resume) realize identical masks; a
+    different seed realizes different ones (for the stochastic models)."""
+    kw = dict(availability=model,
+              dropout_prob=0.0 if model == "always" else 0.4)
+    a, b = _env(**kw), _env(**kw)
+    masks = [a.round_env(r).live for r in range(30)]
+    for r in range(30):
+        np.testing.assert_array_equal(masks[r], b.round_env(r).live)
+    if model != "always":
+        other = _env(seed=8, **kw)
+        assert any(
+            not np.array_equal(masks[r], other.round_env(r).live)
+            for r in range(30)
+        )
+
+
+def test_always_and_sine_and_cohort_shapes():
+    env = _env(availability="always", dropout_prob=0.0)
+    r = env.round_env(0)
+    assert r.live.tolist() == [1.0] * 8 and r.live_count == 8.0
+    assert r.stats["fedsim/participation_rate"] == 1.0
+    # sine: the realized drop probability oscillates — at a high peak prob
+    # the trough rounds (sin == -1 -> p = 0) are all-live by construction
+    env = _env(availability="sine", dropout_prob=0.9, availability_period=16)
+    trough = env.round_env(12).live  # sin(2*pi*12/16) == -1
+    assert trough.sum() == 8
+    # cohort: slots of one cohort share their fate (slot i -> cohort i % n)
+    env = _env(availability="cohort", dropout_prob=0.5, num_cohorts=4)
+    for r in range(20):
+        live = env.round_env(r).live
+        for c in range(4):
+            assert len({float(v) for v in live[c::4]}) == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos plans
+# ---------------------------------------------------------------------------
+
+def test_chaos_parser_grammar():
+    plan = parse_chaos("dropout@0.3:rounds=50-100,nan_client@120,"
+                       "straggler@0.2")
+    assert plan == (
+        ChaosEvent("dropout", 0.3, 50, 100),
+        ChaosEvent("nan_client", 120.0, 120, 120),
+        ChaosEvent("straggler", 0.2, 0, None),
+    )
+    assert parse_chaos("") == ()
+    assert parse_chaos("dropout@0.5:rounds=7-7")[0].end == 7
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus@1",               # unknown kind
+    "dropout@1.5",           # probability outside [0, 1)
+    "dropout@x",             # not a number
+    "dropout@0.3:rounds=9-5",  # descending range
+    "dropout@0.3:r=5",       # unknown option
+    "nan_client@-1",         # negative round
+    "nan_client@1.5",        # fractional round
+    "nan_client@3:rounds=1-2",  # nan_client takes no rounds=
+    "dropout",               # no @value
+])
+def test_chaos_parser_rejects(bad):
+    with pytest.raises(ValueError, match="chaos"):
+        parse_chaos(bad)
+
+
+def test_chaos_rounds_validated_against_run_length():
+    plan = parse_chaos("dropout@0.3:rounds=50-100,nan_client@120")
+    validate_chaos_rounds(plan, 121)  # just fits
+    with pytest.raises(ValueError, match="120"):
+        validate_chaos_rounds(plan, 120)  # nan round never fires
+    with pytest.raises(ValueError, match="only 60 rounds"):
+        validate_chaos_rounds(parse_chaos("dropout@0.3:rounds=50-100"), 60)
+
+
+def test_chaos_events_realize_straggler_and_nan():
+    env = _env(availability="always", dropout_prob=0.0,
+               chaos="straggler@0.5:rounds=0-99,nan_client@3")
+    seen_straggler = False
+    for r in range(20):
+        re = env.round_env(r)
+        s = re.stats
+        # stragglers are excluded from the live mask but counted apart
+        # from dropped (they DID download + compute)
+        assert s["fedsim/dropped"] == 0.0
+        assert (s["fedsim/straggler_excluded"]
+                == 8 - re.live.sum() == 8 - re.live_count)
+        seen_straggler |= s["fedsim/straggler_excluded"] > 0
+        if r == 3 and re.live_count > 0:
+            assert re.corrupt.sum() == 1
+            assert re.live[np.argmax(re.corrupt)] == 1.0  # a LIVE client
+        else:
+            assert re.corrupt.sum() == 0
+    assert seen_straggler
+
+
+# ---------------------------------------------------------------------------
+# Config validation (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw,match", [
+    (dict(dropout_prob=-0.1), r"dropout_prob"),
+    (dict(dropout_prob=1.0), r"dropout_prob"),  # [0, 1): 1.0 rejected
+    (dict(availability="bogus"), r"availability"),
+    (dict(dropout_prob=0.5), r"always"),  # prob without a model using it
+    (dict(availability="sine", dropout_prob=0.5, availability_period=0),
+     r"availability_period"),
+    (dict(availability="cohort", dropout_prob=0.5, num_cohorts=0),
+     r"num_cohorts"),
+    (dict(chaos="dropout@1.5"), r"chaos"),
+])
+def test_config_rejects_bad_fedsim_knobs(kw, match):
+    with pytest.raises(ValueError, match=match):
+        Config(**kw)
+
+
+def test_divisibility_error_hints_at_masking():
+    """num_workers resizing is NOT how partial participation is modeled —
+    the error must point at the fedsim mask instead."""
+    with pytest.raises(ValueError, match="mask"):
+        Config(num_workers=6, num_devices=4, num_clients=8)
+
+
+def test_fedsim_enabled_gate():
+    assert not Config().fedsim_enabled
+    assert Config(availability="bernoulli", dropout_prob=0.3).fedsim_enabled
+    assert Config(chaos="nan_client@1").fedsim_enabled
+
+
+def test_env_override_on_disabled_session_rejected():
+    """A session built without fedsim traced no masking — an explicit env
+    override must be rejected, not silently dropped while its stats leak
+    into the metrics."""
+    from commefficient_tpu.data import FedSampler
+
+    cfg = Config(**BASE)  # availability='always': fedsim disabled
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    ids, batch = FedSampler(ds, num_workers=8, local_batch_size=4,
+                            seed=1).sample_round(0)
+    with pytest.raises(ValueError, match="fedsim_enabled"):
+        sess.train_round(ids, batch, 0.3, env=_cohort_env(S))
+
+
+# ---------------------------------------------------------------------------
+# masked-round unbiasedness (satellite) — all six modes, TinyMLP
+# ---------------------------------------------------------------------------
+
+MODE_CONFIGS = {
+    "uncompressed": dict(mode="uncompressed", virtual_momentum=0.9),
+    "sketch": dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                   k=40, num_rows=3, num_cols=256),
+    "true_topk": dict(mode="true_topk", error_type="virtual",
+                      virtual_momentum=0.9, k=40, momentum_dampening=False),
+    "local_topk": dict(mode="local_topk", error_type="local", k=30,
+                       local_momentum=0.9),
+    "fedavg": dict(mode="fedavg", num_local_iters=2, local_lr=0.1,
+                   local_batch_size=8),
+    "powersgd": dict(mode="powersgd", error_type="virtual",
+                     virtual_momentum=0.9, powersgd_rank=2),
+}
+S = np.array([0, 2, 3, 5, 7])  # the live cohort (5 of 8 slots)
+
+
+def _cohort_env(live_slots, num_workers=8, corrupt_slot=None):
+    live = np.zeros(num_workers, np.float32)
+    live[live_slots] = 1.0
+    corrupt = np.zeros(num_workers, np.float32)
+    if corrupt_slot is not None:
+        corrupt[corrupt_slot] = 1.0
+    n = float(live.sum())
+    return RoundEnv(
+        live=live, corrupt=corrupt, live_count=np.float32(n),
+        stats={"fedsim/participation_rate": n / num_workers,
+               "fedsim/dropped": num_workers - n,
+               "fedsim/straggler_excluded": 0.0,
+               "fedsim/all_dropped": float(n == 0)},
+    )
+
+
+def _rounds(cfg, sampler_bs, env=None, subset=None, n_rounds=3, lr=0.3):
+    """Run rounds through a fresh session; ``env`` drives the masked run,
+    ``subset`` restricts the batch to cohort rows for the oracle run."""
+    from commefficient_tpu.data import FedSampler
+
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=8, local_batch_size=sampler_bs,
+                         seed=1)
+    m = None
+    for r in range(n_rounds):
+        ids, batch = sampler.sample_round(r)
+        L = cfg.round_microbatches
+        if L:
+            batch = {
+                k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                for k, v in batch.items()
+            }
+        if subset is not None:
+            ids, batch = ids[subset], {k: v[subset] for k, v in batch.items()}
+        m = sess.train_round(ids, batch, lr, env=env)
+    return sess, m
+
+
+@pytest.mark.parametrize("name", sorted(MODE_CONFIGS))
+def test_masked_round_unbiased_per_mode(name):
+    """Masked round with live cohort S == unmasked round over exactly S:
+    masking commutes with device_encode (linear) and the live-count
+    renormalization matches the smaller round's /|S| average. Same clients,
+    same batches, same per-client noise rngs — the ONLY difference is who
+    transmits."""
+    kw = dict(MODE_CONFIGS[name])
+    base = dict(BASE)
+    base["local_batch_size"] = kw.pop("local_batch_size",
+                                      base["local_batch_size"])
+    bs = base["local_batch_size"] * (kw.get("num_local_iters", 1)
+                                     if name == "fedavg" else 1)
+    base.pop("num_workers"), base.pop("num_devices")
+    cfg_masked = Config(num_workers=8, num_devices=8,
+                        availability="bernoulli", dropout_prob=0.5,
+                        **base, **kw)
+    cfg_oracle = Config(num_workers=len(S), num_devices=1, **base, **kw)
+    sm, metrics = _rounds(cfg_masked, bs, env=_cohort_env(S))
+    so, _ = _rounds(cfg_oracle, bs, subset=S)
+    assert metrics["fedsim/participation_rate"] == len(S) / 8
+    np.testing.assert_allclose(
+        _final_vec(sm), _final_vec(so), atol=1e-6,
+        err_msg=f"{name}: masked round is NOT the cohort-S round",
+    )
+
+
+def test_masked_round_leaves_dropped_client_state_untouched():
+    """local_topk: a dropped client's error/momentum rows carry forward
+    unmodified (it never participated); live clients' rows move."""
+    kw = dict(MODE_CONFIGS["local_topk"])
+    base = {**BASE}
+    base.pop("num_workers"), base.pop("num_devices")
+    cfg = Config(num_workers=8, num_devices=8, availability="bernoulli",
+                 dropout_prob=0.5, **base, **kw)
+    sess, _ = _rounds(cfg, base["local_batch_size"], env=_cohort_env(S),
+                      n_rounds=1)
+    err = np.asarray(sess.state.client_err)
+    vel = np.asarray(sess.state.client_vel)
+    from commefficient_tpu.data import FedSampler
+
+    ids, _ = FedSampler(_setup(cfg.num_clients)[0], num_workers=8,
+                        local_batch_size=4, seed=1).sample_round(0)
+    dropped = np.setdiff1d(np.arange(8), S)
+    # error rows start at zero: dropped participants' rows must STAY zero,
+    # live participants' must not
+    assert np.all(err[ids[dropped]] == 0.0)
+    assert np.all(vel[ids[dropped]] == 0.0)
+    assert np.any(err[ids[S]] != 0.0)
+
+
+def test_corrupt_flag_on_dead_client_cannot_poison():
+    """Documented ordering invariant: the live mask is applied AFTER
+    corruption, so a corrupt flag on a non-live slot injects nothing —
+    only a LIVE corrupted client can poison the aggregate (matters for
+    explicit RoundEnv overrides; the env builder already targets live
+    slots)."""
+    base = {**BASE}
+    base.pop("num_workers"), base.pop("num_devices")
+    cfg = Config(num_workers=8, num_devices=8, availability="bernoulli",
+                 dropout_prob=0.5, mode="uncompressed", **base)
+    # corrupt slot 1, which is NOT in the live cohort S
+    assert 1 not in S
+    sess, m = _rounds(cfg, base["local_batch_size"],
+                      env=_cohort_env(S, corrupt_slot=1), n_rounds=1)
+    assert np.all(np.isfinite(_final_vec(sess)))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_all_dropped_round_freezes_everything():
+    """Zero live clients: params + momentum frozen bitwise, the sentinel
+    stat flags it, and nothing divides by zero."""
+    base = {**BASE}
+    base.pop("num_workers"), base.pop("num_devices")
+    cfg = Config(num_workers=8, num_devices=8, availability="bernoulli",
+                 dropout_prob=0.5, mode="uncompressed", virtual_momentum=0.9,
+                 **base)
+    sess, _ = _rounds(cfg, base["local_batch_size"], env=_cohort_env(S),
+                      n_rounds=2)
+    before = _final_vec(sess).copy()
+    mom = np.asarray(sess.state.momentum).copy()
+    from commefficient_tpu.data import FedSampler
+
+    ids, batch = FedSampler(_setup(cfg.num_clients)[0], num_workers=8,
+                            local_batch_size=4, seed=1).sample_round(5)
+    m = sess.train_round(ids, batch, 0.3, env=_cohort_env([]))
+    assert m["fedsim/all_dropped"] == 1.0
+    assert np.array_equal(before, _final_vec(sess))
+    assert np.array_equal(mom, np.asarray(sess.state.momentum))
+    assert np.isfinite(float(m["loss"]))
+    assert int(np.asarray(sess.state.step)) == 3  # the round still counts
+
+
+def test_masked_offload_matches_hbm_client_state():
+    """offload_client_state changes only the row plumbing — masked rounds
+    must be bit-identical between host-resident and HBM client state."""
+    from commefficient_tpu.data import FedSampler
+
+    base = {**BASE}
+    base.pop("num_workers"), base.pop("num_devices")
+    kw = dict(mode="local_topk", error_type="local", k=30,
+              local_momentum=0.9, availability="bernoulli",
+              dropout_prob=0.5)
+
+    def run(offload):
+        cfg = Config(num_workers=8, num_devices=8, device_data=False,
+                     offload_client_state=offload, **base, **kw)
+        ds, params, loss_fn = _setup(cfg.num_clients)
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=8, local_batch_size=4, seed=1)
+        for r in range(3):
+            ids, batch = sampler.sample_round(r)
+            sess.train_round(ids, batch, 0.3, env=_cohort_env(S))
+        return _final_vec(sess)
+
+    np.testing.assert_array_equal(run(False), run(True))
+
+
+def test_masked_fsdp_matches_masked_replicated():
+    """The FSDP round applies the same mask semantics as the replicated
+    round (mask -> renormalize -> freeze guard), sharded."""
+    base = {**BASE}
+    base.pop("num_workers"), base.pop("num_devices")
+    kw = dict(mode="true_topk", error_type="virtual", virtual_momentum=0.9,
+              k=40, topk_method="threshold", momentum_dampening=False)
+    cfg_r = Config(num_workers=8, num_devices=8, availability="bernoulli",
+                   dropout_prob=0.5, **base, **kw)
+    cfg_f = cfg_r.replace(fsdp=True)
+    sr, _ = _rounds(cfg_r, base["local_batch_size"], env=_cohort_env(S))
+    sf, _ = _rounds(cfg_f, base["local_batch_size"], env=_cohort_env(S))
+    np.testing.assert_allclose(
+        _final_vec(sr), np.asarray(sf.state.params_vec)[: sf.grad_size],
+        atol=1e-5,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ledger live-byte accounting + schema (satellites)
+# ---------------------------------------------------------------------------
+
+def test_ledger_masked_accounting_is_exact(tmp_path):
+    """cum bytes == sum of live_i x per-client bytes EXACTLY, through the
+    compressor's mask-aware hook, and the schema checker enforces it."""
+    from commefficient_tpu.compress import get_compressor
+    from commefficient_tpu.telemetry import CommLedger
+
+    cfg = Config(mode="local_topk", error_type="local", k=10,
+                 availability="bernoulli", dropout_prob=0.3)
+    comp = get_compressor(cfg, d=1000)
+    bpr = {"upload_floats": 20, "download_floats": 1000,
+           "upload_bytes": 80, "download_bytes": 4000}
+    led = CommLedger(bpr, mode="local_topk", num_workers=8, masked=True,
+                     compressor=comp)
+    lives = [5, 8, 0, 3]
+    for s, live in enumerate(lives):
+        scal = {"fedsim/participation_rate": live / 8,
+                "fedsim/dropped": float(8 - live) if live else 8.0,
+                "fedsim/straggler_excluded": 0.0}
+        out = led.on_round(s, scal)
+        assert out["comm/up_bytes"] == live * 80
+    assert led.cum_up_bytes == sum(lives) * 80
+    summ = led.summary()
+    assert summ["live_client_rounds"] == sum(lives)
+    led.write(str(tmp_path))
+    mod = _schema_checker()
+    mod.validate_comm_ledger(tmp_path / "comm_ledger.json")
+    # tampering with the live sum must fail the invariant
+    bad = json.loads((tmp_path / "comm_ledger.json").read_text())
+    bad["live_client_rounds"] += 1
+    (tmp_path / "comm_ledger.json").write_text(json.dumps(bad))
+    with pytest.raises(mod.SchemaError, match="live_client_rounds"):
+        mod.validate_comm_ledger(tmp_path / "comm_ledger.json")
+
+
+def test_flight_dump_carries_participation_history(tmp_path):
+    from commefficient_tpu.telemetry import FlightRecorder
+
+    fl = FlightRecorder(logdir=str(tmp_path), window=8)
+    for s in range(5):
+        fl.record(s, 0.1, {"loss": 1.0, "fedsim/participation_rate": 0.75})
+    path = fl.dump(4, reason="test", first_bad_step=None)
+    rec = json.loads(open(path).read())
+    assert rec["participation_history"] == [[s, 0.75] for s in range(5)]
+    _schema_checker().validate_flight(path)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through cv_train (satellite + acceptance)
+# ---------------------------------------------------------------------------
+
+def _cv_kwargs(tmp_path, **kw):
+    base = dict(
+        dataset_name="femnist", model="resnet9", num_clients=6,
+        num_workers=4, num_devices=4, local_batch_size=32, num_epochs=1,
+        pivot_epoch=1, lr_scale=0.1, telemetry_level=1,
+        dataset_dir=str(tmp_path), logdir=str(tmp_path / "runs"), seed=0,
+    )
+    base.update(kw)
+    return base
+
+
+def _run_dir(tmp_path):
+    runs = sorted((tmp_path / "runs").iterdir())
+    assert len(runs) == 1
+    return runs[0]
+
+
+def test_cv_train_dropout_nan_client_ledger_and_flight(tmp_path):
+    """One bernoulli@0.3 cv_train run under chaos, covering the whole
+    observable surface in a single ResNet-9 compile (tier-1 budget):
+
+      * chaos nan_client end-to-end — the DivergenceError names the
+        injected round (the in-graph sentinel sees the corrupted params at
+        round 2 itself), and the flight dump carries the participation
+        history window;
+      * fedsim/participation_rate rides metrics.jsonl for every drained
+        round;
+      * the ledger — written on crash like any partial ledger — is exact
+        over the drained rounds: cum bytes == live-client sum x per-client
+        bytes (checker-enforced AND recomputed from the logged rates)."""
+    from commefficient_tpu.telemetry import DivergenceError
+    from commefficient_tpu.train.cv_train import main as cv_main
+
+    with pytest.raises(DivergenceError) as ei:
+        cv_main([], **_cv_kwargs(
+            tmp_path, mode="local_topk", error_type="local", k=2000,
+            availability="bernoulli", dropout_prob=0.3,
+            chaos="nan_client@2",
+        ))
+    assert ei.value.step == 2
+    run = _run_dir(tmp_path)
+    mod = _schema_checker()
+    mod.validate_run_dir(run)  # masked ledger invariant enforced inside
+    flights = sorted(run.glob("flight_*.json"))
+    assert flights, "no flight dump written"
+    rec = json.loads(flights[0].read_text())
+    hist = rec["participation_history"]
+    assert [s for s, _ in hist] == [r["step"] for r in rec["records"]]
+    rates = [
+        json.loads(line) for line in open(run / "metrics.jsonl")
+        if '"fedsim/participation_rate"' in line
+    ]
+    assert [r["step"] for r in rates] == [0, 1, 2]  # drained up to the raise
+    ledger = json.loads((run / "comm_ledger.json").read_text())
+    live_sum = round(sum(r["value"] for r in rates) * 4)  # W = 4
+    assert ledger["live_client_rounds"] == live_sum
+    assert ledger["cum_up_bytes"] == live_sum * ledger["bytes_per_round"][
+        "upload_bytes"]
+
+
+@pytest.mark.slow  # the d~6.6M CountSketch einsum costs minutes on CPU
+def test_cv_train_bernoulli_sketch_completes(tmp_path):
+    """Acceptance twin of the test above in sketch mode (the paper's
+    headline compressor) — slow tier, same assertions."""
+    from commefficient_tpu.train.cv_train import main as cv_main
+
+    val = cv_main([], **_cv_kwargs(
+        tmp_path, mode="sketch", error_type="virtual", virtual_momentum=0.9,
+        k=2000, num_rows=3, num_cols=100_000, topk_method="threshold",
+        availability="bernoulli", dropout_prob=0.3,
+    ))
+    assert np.isfinite(val["loss"])
+    run = _run_dir(tmp_path)
+    _schema_checker().validate_run_dir(run)
+    ledger = json.loads((run / "comm_ledger.json").read_text())
+    assert ledger["cum_up_bytes"] == (
+        ledger["live_client_rounds"] * ledger["bytes_per_round"]["upload_bytes"]
+    )
+
+

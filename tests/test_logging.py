@@ -181,7 +181,9 @@ def test_metrics_writer_header_and_walltime(tmp_path):
         recs = [json.loads(line) for line in f]
     header, scalar = recs
     assert header["type"] == "header"
-    assert header["schema_version"] == 1
+    from commefficient_tpu.telemetry import SCHEMA_VERSION
+
+    assert header["schema_version"] == SCHEMA_VERSION
     assert header["config"]["mode"] == "sketch" and header["config"]["k"] == 7
     assert isinstance(header["jax_version"], str)
     assert "device_kind" in header and "start_time" in header
