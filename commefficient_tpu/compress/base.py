@@ -43,6 +43,12 @@ class Compressor:
     supports_fsdp: bool = False
     # True -> FederatedSession builds a CountSketch spec and passes it in
     needs_sketch_spec: bool = False
+    # True -> the class implements server_update_sharded(): the REPLICATED
+    # round can decode the aggregate shard-wise (each chip works on its
+    # D/W coordinate slice, candidates ride a ~W*k all_gather) instead of
+    # every chip redundantly repeating the full-D server extraction. Gated
+    # by cfg.sketch_decode through use_sharded_decode() below.
+    supports_sharded_decode: bool = False
     # True -> the fused flattened-batch gradient fast path is mathematically
     # identical for this mode (nothing per-client in the transmit rule)
     supports_fused_clients: bool = False
@@ -164,6 +170,44 @@ class Compressor:
         counter (powersgd's non-warm-start Q derives from it)."""
         raise NotImplementedError
 
+    # ---- sharded server decode (replicated engine) -----------------------
+    def use_sharded_decode(self, mesh_workers: int) -> bool:
+        """Resolve ``cfg.sketch_decode`` for this mode on a replicated
+        mesh whose ``workers`` axis has ``mesh_workers`` devices.
+
+        ``dense`` / modes without the capability -> False (the legacy
+        full-D ``server_update`` path, bit-identical to pre-PR-6 rounds).
+        ``sharded`` -> True (Config already validated the mode/topk
+        combination). ``auto`` -> sharded exactly when splitting the
+        decode can win AND cannot change results: >1 worker device (on
+        one device there is no redundant work to remove — and the
+        single-device golden recordings stay bit-untouched) and the
+        threshold top-k kernel (the sharded global selection is built on
+        ``topk_threshold_sharded``; exact/approx selections keep the
+        dense path so their tie-breaking semantics are preserved)."""
+        if not self.supports_sharded_decode:
+            return False
+        decode = getattr(self.cfg, "sketch_decode", "auto")
+        if decode == "dense":
+            return False
+        if decode == "sharded":
+            return True
+        return mesh_workers > 1 and self.cfg.topk_method == "threshold"
+
+    def server_update_sharded(self, momentum, error, extra, agg, lr, step,
+                              *, axis_name, Wd, d):
+        """Sharded decode of the replicated round's server update, called
+        INSIDE a shard_map over the ``workers`` axis (size ``Wd``) with
+        every input replicated: this device estimates/extracts only its
+        ``ceil(d/Wd)`` coordinate slice and the cross-shard candidate
+        exchange happens internally (scalar-only threshold collectives +
+        one ~Wd*k all_gather). Returns ``(idx [Wd*kb], val [Wd*kb],
+        new_momentum, new_error, new_extra)`` with idx/val REPLICATED
+        (post-gather) global candidate buffers, val==0 on padding — the
+        round applies ``params.at[idx].add(-val)``. Only classes with
+        ``supports_sharded_decode`` implement it."""
+        raise NotImplementedError
+
     # ---- FSDP (sharded server state) hooks -------------------------------
     def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
                     d, dp, S):
@@ -191,9 +235,24 @@ class Compressor:
         in the replicated round). Subclasses override the ``_agg_sqnorm``/
         ``_error_sqnorm`` primitives (sketch: AMS table estimates) and
         ``fidelity`` (level >= 2), not this driver."""
+        return self._norm_diagnostics(
+            level, agg=agg, new_error=new_error,
+            update_sqnorm=jnp.sum(jnp.square(delta)),
+            fidelity_fn=lambda: self.fidelity(
+                agg=agg, delta=delta, momentum=momentum, error=error,
+                extra=extra, lr=lr,
+            ),
+        )
+
+    def _norm_diagnostics(self, level, *, agg, new_error, update_sqnorm,
+                          fidelity_fn) -> dict:
+        """Shared scaffold of ``diagnostics``/``diagnostics_sparse`` —
+        only how the update's squared norm and the fidelity scalars are
+        obtained differs between the dense and sparse representations, so
+        a new diag scalar lands in both decode paths by construction."""
         d = {
             "grad_norm": jnp.sqrt(self._agg_sqnorm(agg)),
-            "update_norm": jnp.sqrt(jnp.sum(jnp.square(delta))),
+            "update_norm": jnp.sqrt(update_sqnorm),
         }
         ef = self._error_sqnorm(new_error)
         if ef is not None:
@@ -202,9 +261,28 @@ class Compressor:
             d["ef_residual_norm"] = jnp.sqrt(ef)
             d["ef_residual_max"] = d["ef_residual_norm"]
         if level >= 2:
-            d.update(self.fidelity(agg=agg, delta=delta, momentum=momentum,
-                                   error=error, extra=extra, lr=lr))
+            d.update(fidelity_fn())
         return d
+
+    def diagnostics_sparse(self, level: int, *, agg, idx, val, momentum,
+                           error, extra, new_error, lr) -> dict:
+        """``diagnostics`` for a round whose applied update exists only as
+        the sharded decode's ``(idx, val)`` candidate buffers (val==0 on
+        padding) — same scalar names and semantics, no dense [D] delta
+        ever materialized: update_norm sums the candidate values directly
+        (shards own disjoint coordinates, so the sum of squares is exact),
+        and level-2 fidelity goes through ``fidelity_sparse``."""
+        return self._norm_diagnostics(
+            level, agg=agg, new_error=new_error,
+            update_sqnorm=jnp.sum(jnp.square(val)),
+            fidelity_fn=lambda: self.fidelity_sparse(idx=idx, val=val,
+                                                     lr=lr),
+        )
+
+    def fidelity_sparse(self, *, idx, val, lr) -> dict:
+        """Level-2 fidelity from the sparse ``(idx, val)`` update (sharded
+        decode); base modes are exact — nothing to report."""
+        return {}
 
     def _agg_sqnorm(self, agg):
         """Squared L2 norm of the decoded transmitted aggregate; the base

@@ -15,13 +15,12 @@ import jax.numpy as jnp
 from commefficient_tpu.compress.base import KIND_NONE, KIND_TABLE, Compressor
 from commefficient_tpu.compress.registry import register
 from commefficient_tpu.ops.countsketch import (
-    estimate_all,
     estimate_at,
     sketch_sparse,
     sketch_vec,
     table_sqnorm_estimate,
 )
-from commefficient_tpu.ops.topk import topk_threshold_sharded
+from commefficient_tpu.ops.topk import compact_nonzero, topk_threshold_sharded
 
 
 @register("sketch")
@@ -30,6 +29,7 @@ class SketchCompressor(Compressor):
     supports_fsdp = True
     needs_sketch_spec = True
     supports_fused_clients = True
+    supports_sharded_decode = True  # server_update_sharded below
     dense_delta = False  # the unsketched delta already has <= k nonzeros
 
     def _dampening_warnings(self, dampen: bool) -> None:
@@ -83,11 +83,133 @@ class SketchCompressor(Compressor):
             delta = lr * update
         if dampen and rho > 0:
             # zero the momentum sketch at HH coords (fed_aggregator
-            # ~L380-440): estimate m there, subtract its sketch.
-            m_at_hh = jnp.where(update != 0, estimate_all(spec, m), 0.0)
-            m = m - sketch_vec(spec, m_at_hh)
+            # ~L380-440): estimate m at the update's <= k-coordinate
+            # support and subtract the sketch of those point values.
+            # estimate_at + sketch_sparse replace the former full-[D]
+            # estimate_all + dense sketch_vec (identical semantics — the
+            # gather estimate is bit-equal to the matmul path on CPU and
+            # sketch_sparse is the same hash mapping; pinned by
+            # tests/test_sketch_decode.py's dampening regression).
+            hh_idx, hh_val = compact_nonzero(update, cfg.k)
+            m_at_hh = jnp.where(hh_val != 0,
+                                estimate_at(spec, m, hh_idx), 0.0)
+            m = m - sketch_sparse(spec, hh_idx, m_at_hh)
         new_m = m if rho > 0 else momentum
         return delta, new_m, e, extra
+
+    def server_update_sharded(self, momentum, error, extra, agg, lr, step,
+                              *, axis_name, Wd, d):
+        """The FSDP decode discipline applied to the REPLICATED round
+        (runs inside a shard_map over ``axis_name``, every input
+        replicated): the sketch tables stay replicated — only the
+        EXTRACTION is sharded. Each chip estimates its ceil(d/Wd)
+        coordinate slice via ``estimate_at`` over offset global hashes,
+        the global top-<=k threshold comes from ``topk_threshold_sharded``
+        (one scalar pmax + one scalar psum per bisection iteration), each
+        shard compacts its selected entries into a fixed [kb] candidate
+        buffer, and ONE all_gather of those ~Wd*kb (idx, val) pairs (<< D
+        floats) replaces the per-chip full-D decode. Zero-HH error
+        feedback reuses the proven linearity trick: the psum of per-shard
+        ``sketch_sparse`` slice sketches IS the sketch of the full
+        extracted update. No [D] estimate, no [D] unsketch transient, no
+        dense re-sketch — per-chip decode FLOPs drop ~Wd x."""
+        cfg, spec = self.cfg, self.spec
+        dampen = self.resolved_dampening()
+        rho = cfg.virtual_momentum
+        S = -(-d // Wd)
+        my, idx_c, in_range = self._slice_coords(axis_name, S, d)
+        m = rho * momentum + agg if rho > 0 else agg
+        sel, upd, e = self._slice_extract(m, error, lr, idx_c, in_range,
+                                          axis_name)
+        if dampen and rho > 0:
+            # sharded twin of the dense branch's sparse dampening: each
+            # shard estimates m at ITS selected coords (compacted to the
+            # <= k support first — estimating the whole slice to read k
+            # entries is the waste the dense-branch satellite removed)
+            # and the psum of slice sketches is the sketch of the full
+            # masked-momentum vector (same linearity as the error
+            # feedback). The mask is the UNSCALED selection support, like
+            # the dense branch's `update != 0` — `sel != 0` would differ
+            # at lr == 0.
+            loc_d, upd_val = compact_nonzero(upd, cfg.k)
+            hh_gidx = jnp.minimum(my * S + loc_d, d - 1)
+            m_at_hh = jnp.where(
+                upd_val != 0,
+                self._shard_estimate_at()(spec, m, hh_gidx), 0.0,
+            )
+            m = m - jax.lax.psum(
+                sketch_sparse(spec, hh_gidx, m_at_hh), axis_name
+            )
+        new_m = m if rho > 0 else momentum
+        # compact this shard's <= k selected entries into a fixed-size
+        # candidate buffer and exchange ~Wd*kb pairs — the ONLY vector
+        # collective in the decode, and it is k-scale, not D-scale
+        loc, val = compact_nonzero(sel, cfg.k)
+        gidx = jnp.minimum(my * S + loc, d - 1)  # padding rows clip
+        # in-range; their val is 0.0, so the apply scatter ignores them
+        g_idx = jax.lax.all_gather(gidx, axis_name).reshape(-1)
+        g_val = jax.lax.all_gather(val, axis_name).reshape(-1)
+        return g_idx, g_val, new_m, e, extra
+
+    @staticmethod
+    def _slice_coords(axis_name, S, d):
+        """This shard's offset-slice geometry, shared by both sharded
+        decodes so the layout convention cannot drift: ``(my, idx_c,
+        in_range)`` — the shard index, the clipped global coordinate
+        slice ``my*S .. my*S+S-1``, and the float mask of coordinates
+        actually inside [0, d)."""
+        my = jax.lax.axis_index(axis_name)
+        idx = my * S + jnp.arange(S, dtype=jnp.int32)
+        return my, jnp.minimum(idx, d - 1), (idx < d).astype(jnp.float32)
+
+    def _slice_extract(self, m, error, lr, idx_c, in_range, axis_name):
+        """Shard-local extraction shared by BOTH sharded decodes (the
+        replicated engine's ``server_update_sharded`` and the FSDP round's
+        ``fsdp_update``), so the algebra cannot drift between them:
+        estimate this shard's coordinate slice, select the global top-<=k
+        (``topk_threshold_sharded``: scalar-only collectives), and run the
+        zero-HH error feedback — the psum of per-shard ``sketch_sparse``
+        slice sketches IS the sketch of the full extracted update
+        (linearity). Returns ``(sel, upd, new_error)``: ``sel`` the
+        lr-resolved APPLIED slice (virtual error banks lr-scaled updates,
+        so sel==upd there; no-error applies lr at extraction), ``upd`` the
+        unscaled selection whose support drives momentum dampening."""
+        cfg, spec = self.cfg, self.spec
+        est_at = self._shard_estimate_at()
+        if cfg.error_type == "virtual":
+            e = error + lr * m
+            est = est_at(spec, e, idx_c) * in_range
+            upd = topk_threshold_sharded(est, cfg.k, axis_name)
+            # zero-HH feedback at k-scale: compact the <= k selected
+            # entries before the slice sketch — scatter is the TPU slow
+            # path, and a scatter over the whole D/W slice to add <= k
+            # nonzeros (the rest exact-zero no-ops) is the same waste the
+            # dampening satellite removed. Same table values; the psum of
+            # the <= k-pair slice sketches is still the sketch of the
+            # full extracted update (linearity).
+            loc, val = compact_nonzero(upd, cfg.k)
+            e = e - jax.lax.psum(
+                sketch_sparse(spec, idx_c[loc], val), axis_name
+            )
+            if cfg.error_decay != 1.0:
+                e = cfg.error_decay * e
+            return upd, upd, e
+        est = est_at(spec, m, idx_c) * in_range
+        upd = topk_threshold_sharded(est, cfg.k, axis_name)
+        return lr * upd, upd, error
+
+    def _shard_estimate_at(self):
+        """Point-estimate kernel for the sharded decode: the fused Pallas
+        realization when the spec dials ``backend='pallas'`` (in-kernel
+        hashes + gather + median, table VMEM-resident — see
+        ops/pallas/decode_kernels.py, which falls back to the plain
+        gather path itself when the table exceeds its VMEM guard), else
+        the backend-agnostic ``estimate_at`` gather path."""
+        if self.spec is not None and self.spec.backend == "pallas":
+            from commefficient_tpu.ops.pallas import estimate_at_pallas
+
+            return estimate_at_pallas
+        return estimate_at
 
     def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
                     d, dp, S):
@@ -96,29 +218,15 @@ class SketchCompressor(Compressor):
         table = sketch_vec(spec, local)
         agg = jax.lax.psum(table, axis_name) / W
         # each chip estimates only its own D/W coordinate range via
-        # offset-indexed global hashes; the global top-k threshold uses
-        # scalar-only collectives (ops.topk.topk_threshold_sharded)
-        my = jax.lax.axis_index(axis_name)
-        idx = my * S + jnp.arange(S, dtype=jnp.int32)
-        in_range = (idx < d).astype(jnp.float32)
-        idx_c = jnp.minimum(idx, d - 1)
+        # offset-indexed global hashes; the shared ``_slice_coords`` /
+        # ``_slice_extract`` helpers (also the replicated engine's
+        # sharded decode) own the slice geometry + scalar-collective
+        # threshold + zero-HH error feedback, through the fused Pallas
+        # estimate kernel when backend='pallas'
+        _, idx_c, in_range = self._slice_coords(axis_name, S, d)
         m = rho * m_in + agg if rho > 0 else agg
-        if cfg.error_type == "virtual":
-            e = e_in + lr * m
-            est = estimate_at(spec, e, idx_c) * in_range
-            upd = topk_threshold_sharded(est, cfg.k, axis_name)
-            # linearity: psum of per-shard slice sketches == sketch of the
-            # full extracted update (zero-HH error feedback)
-            e = e - jax.lax.psum(
-                sketch_sparse(spec, idx_c, upd), axis_name
-            )
-            if cfg.error_decay != 1.0:
-                e = cfg.error_decay * e
-            delta_sh = upd
-        else:
-            e = e_in
-            est = estimate_at(spec, m, idx_c) * in_range
-            delta_sh = lr * topk_threshold_sharded(est, cfg.k, axis_name)
+        delta_sh, _, e = self._slice_extract(m, e_in, lr, idx_c, in_range,
+                                             axis_name)
         new_m = m if rho > 0 else m_in
         return p_sh - delta_sh, new_m, e
 
@@ -143,13 +251,30 @@ class SketchCompressor(Compressor):
         current k/c occupancy — the quantity the sketched-SGD analysis
         (arXiv:1903.04488) bounds; at small d/c it tracks the estimation
         error against the exact top-k the unsketch approximates (a huge
-        table drives it to ~0 — pinned by tests/test_telemetry.py). Cost:
-        one extra sketch + estimate pass per round (level 2 only)."""
+        table drives it to ~0 — pinned by tests/test_telemetry.py).
+
+        Sparse-aware since the decode PR: the delta has <= k nonzeros, so
+        the fresh table comes from ``sketch_sparse`` at its compacted
+        support and the re-estimate from ``estimate_at`` there — same
+        values (same hash mapping; gather == matmul path on CPU), but
+        level 2 no longer adds a full-[D] sketch + estimate matmul pass
+        per round (one cumsum over delta to find the support, then
+        k-scale work)."""
+        idx, val = compact_nonzero(delta, self.cfg.k)
+        return self._fidelity_at(idx, val)
+
+    def fidelity_sparse(self, *, idx, val, lr) -> dict:
+        """Sharded-decode twin of ``fidelity``: the update already exists
+        as (idx, val) candidate buffers (val==0 padding) — no compaction,
+        no dense delta."""
+        return self._fidelity_at(idx, val)
+
+    def _fidelity_at(self, idx, val) -> dict:
         spec = self.spec
-        rt = estimate_all(spec, sketch_vec(spec, delta))
-        mask = delta != 0
-        num = jnp.sqrt(jnp.sum(jnp.square(jnp.where(mask, rt - delta, 0.0))))
-        den = jnp.sqrt(jnp.sum(jnp.square(delta)))
+        live = val != 0
+        rt = estimate_at(spec, sketch_sparse(spec, idx, val), idx)
+        num = jnp.sqrt(jnp.sum(jnp.square(jnp.where(live, rt - val, 0.0))))
+        den = jnp.sqrt(jnp.sum(jnp.square(val)))
         return {"sketch_est_rel_err": num / jnp.maximum(den, 1e-30)}
 
     def upload_floats(self) -> int:

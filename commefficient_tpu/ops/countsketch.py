@@ -759,21 +759,26 @@ def estimate_all(spec: CountSketch, table: jnp.ndarray) -> jnp.ndarray:
     """
     use_pallas = _use_pallas(spec)  # validate the backend string even on
     # the gather path below — every entry point fails loudly on a typo
-    if spec.num_blocks > 1:
-        B = spec.num_blocks
-        blk = -(-spec.d // B)
-        idx = jnp.arange(B * blk, dtype=jnp.uint32).reshape(B, blk)
-        idx = jnp.minimum(idx, jnp.uint32(spec.d - 1))  # pad: repeat last
-        est = jax.lax.map(lambda ix: estimate_at(spec, table, ix), idx)
-        return est.reshape(B * blk)[: spec.d]
-    if use_pallas:
-        from commefficient_tpu.ops.pallas import estimate_all_pallas
+    # named_scope marker (no ops added): the scope name survives into the
+    # compiled HLO's op metadata, so tests can pin that a lowered program
+    # contains NO full-d estimate — the sharded-decode acceptance
+    # criterion (tests/test_sketch_decode.py's HLO pin)
+    with jax.named_scope("estimate_all"):
+        if spec.num_blocks > 1:
+            B = spec.num_blocks
+            blk = -(-spec.d // B)
+            idx = jnp.arange(B * blk, dtype=jnp.uint32).reshape(B, blk)
+            idx = jnp.minimum(idx, jnp.uint32(spec.d - 1))  # pad: repeat last
+            est = jax.lax.map(lambda ix: estimate_at(spec, table, ix), idx)
+            return est.reshape(B * blk)[: spec.d]
+        if use_pallas:
+            from commefficient_tpu.ops.pallas import estimate_all_pallas
 
-        return estimate_all_pallas(spec, table)
-    ests = jnp.stack(
-        [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
-    )
-    return _unscramble(spec, _median_rows(ests))
+            return estimate_all_pallas(spec, table)
+        ests = jnp.stack(
+            [_estimate_one_row(spec, table[r], r) for r in range(spec.r)]
+        )
+        return _unscramble(spec, _median_rows(ests))
 
 
 def _scrambled_pos(spec: CountSketch, idx: jnp.ndarray) -> jnp.ndarray:

@@ -10,9 +10,11 @@ from commefficient_tpu.ops.pallas.countsketch_kernels import (
     median_rows_pallas,
     sketch_vec_pallas,
 )
+from commefficient_tpu.ops.pallas.decode_kernels import estimate_at_pallas
 
 __all__ = [
     "estimate_all_pallas",
+    "estimate_at_pallas",
     "median_rows_pallas",
     "sketch_vec_pallas",
 ]

@@ -1,0 +1,130 @@
+"""Fused Pallas point-estimate kernel for the sharded sketch decode.
+
+The sharded decode (``SketchCompressor.server_update_sharded`` /
+``fsdp_update``) estimates each shard's D/W coordinate slice with the
+``estimate_at`` gather path: per row, compute the coordinate's column +
+sign from the hash arithmetic, gather the bucket value, then take the
+median across the r rows. Under XLA that is r separate [S]-sized gathers
+plus an [r, S] stack that round-trips HBM into the median — and the hash
+index/sign vectors are themselves materialized [S] intermediates.
+
+``estimate_at_pallas`` fuses the whole thing into ONE kernel: a grid over
+coordinate tiles keeps the sketch table resident in VMEM, generates each
+row's columns and signs on the fly from the scrambled position (uint32
+arithmetic only — the same ``_row_cols_signs`` mapping, bit-identical on
+the shared geometry), gathers the r bucket values, and runs the
+median-of-r compare-exchange network in-registers before writing its [TS]
+output tile. The per-shard [r, S] estimate stack never exists in HBM;
+only the final [S] median does (the threshold-count bisection that
+follows streams that — S = D/W per chip, not D).
+
+Scope guard: the table must fit VMEM (``r * c_actual * 4`` bytes against
+``VMEM_TABLE_BYTES``). When it does not — e.g. the GPT-2 5x5M table — the
+wrapper falls back to the plain ``estimate_at`` gather path at trace
+time, so callers can dial ``backend='pallas'`` unconditionally. On CPU
+hosts every kernel runs under Pallas interpret mode (tier-1 parity tests);
+on a TPU backend the same calls compile through Mosaic.
+
+Only the scramble-position lookup (one [S] gather over the static inverse
+block permutation) stays outside the kernel, exactly like the layout
+permutations stay outside the sketch/estimate kernels in
+countsketch_kernels.py — keeping them shared guarantees every backend
+uses one geometry.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from commefficient_tpu.ops.countsketch import (
+    _GOLDEN,
+    _ceil_mult,
+    _median_rows,
+    _mix32,
+    _poly4_u32,
+    _scrambled_pos,
+    estimate_at,
+)
+from commefficient_tpu.ops.pallas.countsketch_kernels import (
+    _check_poly4_field,
+    _interpret,
+)
+
+# VMEM budget for the resident [r, c_actual] table (v5e cores have ~16 MiB
+# of VMEM; leave headroom for the tile buffers + accumulators). Above this
+# the wrapper falls back to the unfused gather path.
+VMEM_TABLE_BYTES = 12 << 20
+
+
+def _row_static(spec, row: int):
+    """Static per-row ints the in-kernel hash math needs."""
+    f = spec._factor(row)
+    L = spec._L_row(row)
+    return dict(
+        f=f, G=L // f, m=spec.chunk_m, s=spec.s_row(row), V=spec.V_row(row),
+    )
+
+
+def _row_col_sign(spec, row: int, spos: jnp.ndarray):
+    """(column [n] int32, sign [n] f32) of scrambled positions for one row
+    — the ``_row_cols_signs`` mapping evaluated with kernel-safe uint32
+    arithmetic only (no static [m]/[d_eff] table gathers: the poly4 slots
+    come from ``_poly4_u32``, bit-identical to the host uint64 family)."""
+    g = _row_static(spec, row)
+    f, G, m, s, V = g["f"], g["G"], g["m"], g["s"], g["V"]
+    if f > 1:
+        pos = (spos % jnp.uint32(G)) * jnp.uint32(f) + spos // jnp.uint32(G)
+    else:
+        pos = spos
+    chunk = (pos // jnp.uint32(m)).astype(jnp.int32)
+    off = pos % jnp.uint32(m)
+    if spec.hash_family == "poly4":
+        c_slot = tuple(int(c) for c in spec._poly4_coeffs(row, 0))
+        c_sign = tuple(int(c) for c in spec._poly4_coeffs(row, 1))
+        h = (_poly4_u32(off, c_slot) % jnp.uint32(V)).astype(jnp.int32)
+        bits = _poly4_u32(spos, c_sign) & jnp.uint32(1)
+    else:
+        key = spec._row_key(row)
+        h = (_mix32(off, key) % jnp.uint32(V)).astype(jnp.int32)
+        bits = _mix32(spos, key ^ _GOLDEN) & jnp.uint32(1)
+    sign = 1.0 - 2.0 * bits.astype(jnp.float32)
+    return chunk * s + h, sign
+
+
+def estimate_at_pallas(spec, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """Fused median-of-rows point estimates for a coordinate subset —
+    drop-in for ``estimate_at`` (same values to fp32 rounding; bit-equal
+    under interpret mode, pinned by tests/test_sketch_decode.py). Falls
+    back to the unfused gather path when the table exceeds the VMEM guard."""
+    r, c_actual = spec.table_shape
+    if r * c_actual * 4 > VMEM_TABLE_BYTES:
+        return estimate_at(spec, table, idx)
+    _check_poly4_field(spec)
+    n = idx.shape[0]
+    TS = min(4096, _ceil_mult(max(n, 1), 128))
+    n_pad = _ceil_mult(max(n, 1), TS)
+    spos = _scrambled_pos(spec, idx.astype(jnp.uint32))
+    spos = jnp.pad(spos, (0, n_pad - n)).reshape(1, n_pad)
+
+    def kernel(spos_ref, table_ref, out_ref):
+        sp = spos_ref[0, :].astype(jnp.uint32)
+        ests = []
+        for row in range(spec.r):
+            cols, sign = _row_col_sign(spec, row, sp)
+            ests.append(table_ref[row, :][cols] * sign)
+        out_ref[0, :] = _median_rows(jnp.stack(ests))
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // TS,),
+        in_specs=[
+            pl.BlockSpec((1, TS), lambda i: (0, i)),
+            pl.BlockSpec((r, c_actual), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TS), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=_interpret(),
+    )(spos, table.astype(jnp.float32))
+    return out[0, :n]

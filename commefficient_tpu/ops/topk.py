@@ -97,6 +97,38 @@ def topk_threshold_sharded(v_local: jnp.ndarray, k: int, axis_name: str,
     return v_local * ((mag >= hi) & (mag > 0))
 
 
+def compact_nonzero(v: jnp.ndarray, k: int):
+    """Compact a ≤k-sparse dense vector into fixed-size ``(idx [kb], val
+    [kb])`` buffers (``kb = min(k, len(v))``), positions ascending, padded
+    with ``(0, 0.0)`` — the TPU-friendly compaction the sharded sketch
+    decode and the sparse telemetry paths are built on.
+
+    No sort and no len(v)-sized scatter (both are the TPU slow paths —
+    ``lax.top_k`` measures ~40 ms at d=6.5M, a 50k scatter ~24 ms): one
+    ``cumsum`` pass over the mask gives each selected element its output
+    slot, and ``searchsorted`` over that monotone prefix-count inverts the
+    mapping with kb vectorized binary searches (gathers, not scatters).
+    Consumers rely on the padding contract: padded entries carry val==0.0
+    so a downstream ``.at[idx].add(val)`` / ``sketch_sparse`` treats them
+    as no-ops, and masks derived from ``val != 0`` drop them from norms.
+    A vector with MORE than k nonzeros keeps the first kb by position
+    (callers in this codebase always pass the output of a top-≤k
+    selection, which cannot exceed k).
+    """
+    n = v.shape[0]
+    kb = min(int(k), n)
+    csum = jnp.cumsum((v != 0).astype(jnp.int32))
+    total = csum[-1]
+    # slot j (1-indexed) lives at the first position whose prefix count
+    # reaches j; past-the-end probes return n and are masked below
+    idx = jnp.searchsorted(
+        csum, jnp.arange(1, kb + 1, dtype=jnp.int32), side="left"
+    )
+    idx = jnp.minimum(idx, n - 1).astype(jnp.int32)
+    valid = jnp.arange(kb, dtype=jnp.int32) < total
+    return jnp.where(valid, idx, 0), jnp.where(valid, v[idx], 0.0)
+
+
 def mask_out_indices(v: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Zero the given coordinates — the error-feedback "forget what was sent"
     step (``Ve[hh]=0`` in fed_aggregator.py ~L440-480)."""

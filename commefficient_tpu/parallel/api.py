@@ -158,6 +158,33 @@ class FederatedSession:
         # accounting (bytes_per_round); the round builders construct their
         # own trace-time instances from the same registry.
         self.compressor = get_compressor(cfg, d=self.grad_size, spec=self.spec)
+        # sketch server-decode resolution (cfg.sketch_decode; the round
+        # builder makes the same call from the same inputs) — surfaced so
+        # bench/profiling/tests can report which decode a session compiled
+        # without re-deriving the auto rule. FSDP rounds have their own
+        # (always-sharded) extraction, so the knob is moot there.
+        _ws = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[WORKERS]
+        self.sketch_decode_resolved = (
+            "sharded"
+            if not cfg.fsdp and self.compressor.use_sharded_decode(_ws)
+            else "dense"
+        )
+        if (
+            cfg.sketch_decode == "sharded"
+            and not cfg.fsdp
+            and _ws == 1
+        ):
+            import warnings
+
+            warnings.warn(
+                "sketch_decode='sharded' on a 1-device workers mesh is the "
+                "degenerate case: one 'shard' decodes the FULL coordinate "
+                "range through the estimate_at gather path (the TPU slow "
+                "path — the FSDP analog measured ~6x the replicated round "
+                "at D=124M, runs/r5_fsdp_gpt2.log). The sharded win only "
+                "exists when the workers axis is real; 'auto' picks dense "
+                "here for exactly that reason."
+            )
         # federated environment simulator (fedsim/): None unless the config
         # turns masking/chaos on — the round builders then trace the masked
         # aggregation and every train_round consumes one RoundEnv. The host

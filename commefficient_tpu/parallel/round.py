@@ -73,7 +73,10 @@ from commefficient_tpu.models.losses import IGNORE_INDEX
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
 from commefficient_tpu.parallel.mesh import WORKERS
-from commefficient_tpu.telemetry import round_diagnostics
+from commefficient_tpu.telemetry import (
+    round_diagnostics,
+    round_diagnostics_sparse,
+)
 from commefficient_tpu.utils.config import Config
 from commefficient_tpu.utils.jax_compat import (
     grad_extra_axes_psum,
@@ -365,6 +368,35 @@ def build_round_fn(
         out_specs=(P(), P(), P(), shard_spec, shard_spec),
     )
 
+    # ---- sharded server decode (the FSDP decode discipline on replicated
+    # state; compress/sketch.py server_update_sharded): resolved at trace
+    # time from cfg.sketch_decode + the compressor capability + the mesh —
+    # a python-level gate like telemetry_level/fedsim, so the dense round's
+    # trace is untouched when off (golden recordings pin it). When on, the
+    # server update runs INSIDE a second shard_map over the same workers
+    # axis: each chip decodes only its D/W coordinate slice and the round
+    # applies the gathered ~W*k (idx, val) candidates as a k-sparse
+    # scatter — no [D] estimate, no [D] unsketch transient, no dense
+    # re-sketch, no D-sized collective (pinned by the HLO test in
+    # tests/test_sketch_decode.py).
+    Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
+    sharded_decode = comp.use_sharded_decode(Wd)
+    decode_mapped = None
+    if sharded_decode:
+
+        def decode_shard(momentum, error, comp_state, agg, lr, step):
+            return comp.server_update_sharded(
+                momentum, error, comp_state, agg, lr, step,
+                axis_name=WORKERS, Wd=Wd, d=d,
+            )
+
+        decode_mapped = shard_map(
+            decode_shard,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(), P()),
+        )
+
     def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(),
                  err_rows=(), env=()):
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
@@ -410,55 +442,96 @@ def build_round_fn(
             agg = agg * scale
             loss = loss * scale  # loss becomes the mean over LIVE clients
         # ---- server update (fed_aggregator _server_helper_* ~L380-540):
-        # the compressor's momentum/error algebra + update extraction,
-        # returning the APPLIED delta (w -= delta)
-        delta, new_m, new_e, new_comp = comp.server_update(
-            state.momentum, state.error, state.comp, agg, lr, state.step
-        )
+        # the compressor's momentum/error algebra + update extraction.
+        # Only how the update is OBTAINED and APPLIED differs between the
+        # decode paths; the fedsim all-dropped guard, the state merges,
+        # and the metrics/telemetry assembly below are shared so their
+        # semantics cannot drift between decodes.
+        if sharded_decode:
+            # sharded decode: each chip extracts its D/W slice inside the
+            # shard_map; the replicated outputs are the gathered ~Wd*k
+            # (idx, val) candidate buffers (val==0 padding) + the updated
+            # (replicated) server-state leaves. The update applies as a
+            # k-sparse scatter — the dense [D] delta never exists.
+            # (do_topk_down is moot here: every sharded-decode mode has
+            # dense_delta=False — the candidates are already <= k pairs.)
+            with jax.named_scope("sketch_decode_sharded"):
+                g_idx, g_val, new_m, new_e, new_comp = decode_mapped(
+                    state.momentum, state.error, state.comp, agg, lr,
+                    state.step,
+                )
+        else:
+            # dense decode (legacy path): the compressor returns the
+            # APPLIED delta (w -= delta), full-[D] on every chip. The
+            # named_scope is an HLO marker like telemetry_diag's: its
+            # absence from the compiled sharded round proves this branch
+            # was never traced (tests/test_sketch_decode.py).
+            with jax.named_scope("server_decode_dense"):
+                delta, new_m, new_e, new_comp = comp.server_update(
+                    state.momentum, state.error, state.comp, agg, lr,
+                    state.step,
+                )
+            if cfg.do_topk_down and comp.dense_delta:
+                # downlink compression (reference down-compression flag):
+                # the broadcast weight delta is itself top-k sparsified, so
+                # the download really is 2k floats (bytes_per_round
+                # accounting). Lossy by design, as in the reference —
+                # coordinates dropped here are NOT re-banked into client
+                # error. Skipped for compressors whose delta is already
+                # compressed (sketch/true_topk: <= k nonzeros; powersgd:
+                # rank-r factored — a full-[D] selection there would be a
+                # pure waste).
+                delta = comp.topk(delta, cfg.k)
         if use_fedsim:
             # all-clients-dropped guard: nothing arrived, so nothing may
-            # move — params freeze and every server-state leaf (momentum/
-            # error/compressor-private) carries forward; the host-side
-            # fedsim/all_dropped sentinel rides the metrics instead of a
-            # 0/0 poisoning the run
+            # move — params freeze (the dense delta, or the sharded
+            # candidate VALUES whose scatter then adds 0.0, zero out) and
+            # every server-state leaf (momentum/error/compressor-private)
+            # carries forward; the host-side fedsim/all_dropped sentinel
+            # rides the metrics instead of a 0/0 poisoning the run
             ok = live_count > 0
-            delta = jnp.where(ok, delta, 0.0)
 
             def keep(new, old):
                 return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
                                     new, old)
 
+            if sharded_decode:
+                g_val = jnp.where(ok, g_val, 0.0)
+            else:
+                delta = jnp.where(ok, delta, 0.0)
             new_m = keep(new_m, state.momentum)
             new_e = keep(new_e, state.error)
             new_comp = keep(new_comp, state.comp)
-        if cfg.do_topk_down and comp.dense_delta:
-            # downlink compression (reference down-compression flag): the
-            # broadcast weight delta is itself top-k sparsified, so the
-            # download really is 2k floats (bytes_per_round accounting).
-            # Lossy by design, as in the reference — coordinates dropped
-            # here are NOT re-banked into client error. Skipped for
-            # compressors whose delta is already compressed (sketch/
-            # true_topk: <= k nonzeros; powersgd: rank-r factored — a
-            # full-[D] selection there would be a pure waste).
-            delta = comp.topk(delta, cfg.k)
-        new_params = state.params_vec - delta
+        new_params = (
+            state.params_vec.at[g_idx].add(-g_val)
+            if sharded_decode
+            else state.params_vec - delta
+        )
         metrics = {"loss": loss, **aux}
         if cfg.telemetry_level >= 1:
             # in-graph health diagnostics (telemetry/diagnostics.py): ride
-            # the metrics dict -> the deferred drain path, no extra fences.
-            # The gate is python-level at trace time, so level 0 traces
-            # NOTHING here (bit-identical round; HLO smoke test).
+            # the metrics dict -> the deferred drain path, no extra
+            # fences. The gate is python-level at trace time, so level 0
+            # traces NOTHING here (bit-identical round; HLO smoke test).
             with jax.named_scope("telemetry_diag"):
-                metrics.update(round_diagnostics(
-                    cfg, comp,
-                    agg=agg, delta=delta, new_params=new_params,
-                    loss=loss, lr=lr,
+                common = dict(
+                    agg=agg, new_params=new_params, loss=loss, lr=lr,
                     momentum=state.momentum, error=state.error,
                     extra=state.comp, new_error=new_e,
-                    client_err_rows=(
-                        new_err if cfg.error_type == "local" else None
-                    ),
-                ))
+                )
+                metrics.update(
+                    round_diagnostics_sparse(
+                        cfg, comp, idx=g_idx, val=g_val, **common
+                    )
+                    if sharded_decode
+                    else round_diagnostics(
+                        cfg, comp, delta=delta,
+                        client_err_rows=(
+                            new_err if cfg.error_type == "local" else None
+                        ),
+                        **common,
+                    )
+                )
         if cfg.offload_client_state:
             new_state = FedState(
                 new_params, new_m, new_e, (), (), state.step + 1, new_comp

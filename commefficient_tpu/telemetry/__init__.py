@@ -42,6 +42,7 @@ Compressor classes, keeping the compress layering at ops+jax).
 from commefficient_tpu.telemetry.diagnostics import (
     nonfinite_sentinel,
     round_diagnostics,
+    round_diagnostics_sparse,
     table_sqnorm_estimate,
 )
 from commefficient_tpu.telemetry.flight import (
@@ -104,6 +105,7 @@ __all__ = [
     "nonfinite_sentinel",
     "record_crash",
     "round_diagnostics",
+    "round_diagnostics_sparse",
     "run_metadata",
     "table_sqnorm_estimate",
 ]

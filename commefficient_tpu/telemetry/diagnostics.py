@@ -103,6 +103,51 @@ def round_diagnostics(
         row_norms = jnp.sqrt(jnp.sum(jnp.square(client_err_rows), axis=-1))
         diag["ef_residual_norm"] = jnp.mean(row_norms)
         diag["ef_residual_max"] = jnp.max(row_norms)
+    return _seal(diag, loss, new_params)
+
+
+def _seal(diag: dict, loss, new_params) -> dict:
+    """Shared tail of both drivers — the sentinel + the ``diag/`` prefix —
+    so a schema change cannot land in one decode path and not the other."""
     finite_scalars = [loss] + [v for v in diag.values()]
     diag["nonfinite"] = nonfinite_sentinel(finite_scalars, vecs=(new_params,))
     return {f"diag/{k}": v for k, v in diag.items()}
+
+
+def round_diagnostics_sparse(
+    cfg,
+    comp,
+    *,
+    agg: Any,
+    idx: jnp.ndarray,
+    val: jnp.ndarray,
+    new_params: jnp.ndarray,
+    loss: jnp.ndarray,
+    lr,
+    momentum: Any,
+    error: Any,
+    extra: Any,
+    new_error: Any,
+) -> dict:
+    """``round_diagnostics`` for the sharded-decode round, whose applied
+    update exists only as the gathered ``(idx, val)`` candidate buffers
+    (val==0 on padding) — no dense [D] delta is ever materialized, so the
+    scalars come from ``Compressor.diagnostics_sparse`` (same names, same
+    semantics; shards own disjoint coordinates so update_norm is exact).
+    Local error feedback never reaches this path (only server-state modes
+    decode sharded), hence no client_err_rows argument."""
+    level = getattr(cfg, "telemetry_level", 0)
+    if level < 1:
+        return {}
+    diag = comp.diagnostics_sparse(
+        level,
+        agg=agg,
+        idx=idx,
+        val=val,
+        momentum=momentum,
+        error=error,
+        extra=extra,
+        new_error=new_error,
+        lr=lr,
+    )
+    return _seal(diag, loss, new_params)

@@ -206,6 +206,22 @@ class Config:
     # [d_eff] sign vector); sketch_backend="pallas" evaluates the
     # polynomial in-kernel and lifts poly4 to GPT-2 scale.
     hash_family: str = "fmix32"
+    # Sketch server-decode strategy for the REPLICATED round ("auto" |
+    # "dense" | "sharded"). "dense": the legacy path — every chip
+    # redundantly runs the full-D estimate_all -> top-k -> unsketch ->
+    # re-sketch server extraction (at D=124M that IS the round; BENCH_r05
+    # gpt2_sketch_vs_uncompressed=0.287). "sharded": the FSDP decode
+    # discipline on replicated state — each chip estimates only its D/W
+    # coordinate slice (estimate_at over offset global hashes), the
+    # global top-<=k threshold uses scalar-only collectives, and ONE
+    # ~W*k-pair all_gather of compacted candidates replaces the per-chip
+    # full-D decode (requires topk_method='threshold'; mode='sketch').
+    # "auto" (default): sharded exactly when it can win and cannot change
+    # results — >1 worker device AND threshold top-k; single-device
+    # rounds and exact/approx selections keep the dense path, so golden
+    # recordings and CPU tier-1 defaults are bit-untouched. See README
+    # "Sketch decode architecture".
+    sketch_decode: str = "auto"
     # CountSketch kernel backend for the matmul-path ops ("einsum" |
     # "pallas"). "einsum" (default): the banded one-hot einsum +
     # overlap-add — runs everywhere, the r1-r5 production path. "pallas":
@@ -353,6 +369,26 @@ class Config:
                 "sketch_backend must be einsum|pallas, "
                 f"got {self.sketch_backend!r}"
             )
+        if self.sketch_decode not in ("auto", "dense", "sharded"):
+            raise ValueError(
+                "sketch_decode must be auto|dense|sharded, "
+                f"got {self.sketch_decode!r}"
+            )
+        if self.sketch_decode == "sharded":
+            if self.mode != "sketch":
+                raise ValueError(
+                    "sketch_decode='sharded' is the sketch server-decode "
+                    f"strategy; mode={self.mode!r} has no sketch decode. "
+                    "Leave sketch_decode='auto' (a no-op for other modes)."
+                )
+            if self.topk_method != "threshold":
+                raise ValueError(
+                    "sketch_decode='sharded' extracts the global top-<=k "
+                    "with the sharded threshold kernel (scalar-only "
+                    "collectives); set topk_method='threshold' (the TPU "
+                    "fast path), or leave sketch_decode='auto' to keep "
+                    f"topk_method={self.topk_method!r} on the dense decode"
+                )
         if self.synthetic_variant not in (
             "flat", "concentrated", "concentrated_v2"
         ):

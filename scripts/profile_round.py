@@ -5,11 +5,18 @@ fences (block_until_ready is unreliable through the axon tunnel), so the
 perf work attacks measured hot spots instead of guesses. The sketch /
 estimate / unsketch phases are timed for BOTH CountSketch backends
 (einsum and pallas — ops/pallas/) so the r5 sketch-round gap is tracked
-at phase granularity. Run WITHOUT the test conftest so it dials the real
-TPU:
+at phase granularity, and the server-DECODE phases (PR 6) are split
+dense vs sharded-slice vs Pallas-fused. ``--d`` runs the phase split at
+an arbitrary dimension — e.g. GPT-2 scale:
+
+    python scripts/profile_round.py --d 124000000 --shards 8
+
+times the decode phases at D=124M (c defaults to D/25, the stability
+envelope floor) without needing a CV model of that size. Run WITHOUT the
+test conftest so it dials the real TPU:
 
     python scripts/profile_round.py [--dtype bfloat16] [--reps 10] \
-        [--sketch_backend pallas]
+        [--sketch_backend pallas] [--d N] [--num_cols C] [--shards W]
 """
 
 from __future__ import annotations
@@ -68,50 +75,82 @@ def main():
         "time the in-graph diagnostics tax (level 2 adds the sketch "
         "round-trip fidelity / powersgd reconstruction residual)",
     )
+    ap.add_argument(
+        "--d", type=int, default=0,
+        help="override the sketch dimension for the phase split (0 = the "
+        "ResNet-9 D). Set 124_000_000 to run the decode phases at GPT-2 "
+        "scale — the model/ground-truth sections are skipped then (no "
+        "CV model exists at that D; the decode numbers are the point)",
+    )
+    ap.add_argument(
+        "--num_cols", type=int, default=0,
+        help="sketch columns for the phase split (0 = 500k at CV scale, "
+        "d//25 under --d — the stability envelope's c >= D/25 floor)",
+    )
+    ap.add_argument(
+        "--shards", type=int, default=8,
+        help="worker-mesh width W the sharded-decode phase lines model: "
+        "each line times ONE shard's d/W slice work (the per-chip cost "
+        "of the sharded decode; its collectives are scalar-only + one "
+        "~W*k gather, negligible next to the slice work)",
+    )
     args = ap.parse_args()
 
     from commefficient_tpu.models import ResNet9, classification_loss
     from commefficient_tpu.ops import ravel_params
     from commefficient_tpu.ops.countsketch import (
-        CountSketch, estimate_all, sketch_sparse, sketch_vec, unsketch_sparse,
+        CountSketch, estimate_all, estimate_at, sketch_sparse, sketch_vec,
+        unsketch_sparse,
     )
+    from commefficient_tpu.ops.topk import compact_nonzero
 
     print(f"devices: {jax.devices()}")
     workers, batch = 8, 256  # the bench r2 shape (2048 samples/round)
-    model = ResNet9(num_classes=10)
-    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
-    loss_fn = classification_loss(model.apply)
-    vec, unravel = ravel_params(params)
-    d = int(vec.size)
+    if args.d:
+        # decode-phase-only run at an arbitrary D (the GPT-2-scale split
+        # VERDICT r5 asked for): no CV model exists at this dimension, so
+        # the model fwd/bwd + powersgd + ground-truth sections are skipped
+        model = params = loss_fn = vec = unravel = None
+        d = args.d
+    else:
+        model = ResNet9(num_classes=10)
+        params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        loss_fn = classification_loss(model.apply)
+        vec, unravel = ravel_params(params)
+        d = int(vec.size)
+    num_cols = args.num_cols or (max(500_000, d // 25) if args.d else 500_000)
     print(f"D = {d}")
     spec = CountSketch(
-        d=d, c=500_000, r=5, seed=42,
+        d=d, c=num_cols, r=5, seed=42,
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
     )
     print(f"table: {spec.table_shape} (c_actual={spec.c_actual}, s={spec.s}, nc={spec.nc})")
 
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(workers * batch, 32, 32, 3)).astype(np.float32))
-    y = jnp.asarray(rng.integers(0, 10, size=(workers * batch,)).astype(np.int32))
+    if not args.d:
+        x = jnp.asarray(rng.normal(size=(workers * batch, 32, 32, 3)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 10, size=(workers * batch,)).astype(np.int32))
     v = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
     k = 50_000
     idx = jnp.asarray(rng.choice(d, size=k, replace=False).astype(np.int32))
     vals = jnp.asarray(rng.normal(size=(k,)).astype(np.float32))
 
-    @jax.jit
-    def fwd_bwd(pv, x, y):
-        p = unravel(pv)
-        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, {"x": x, "y": y})
-        g, _ = jax.flatten_util.ravel_pytree(grads)
-        return g
+    if not args.d:
 
-    @jax.jit
-    def per_worker_fwd_bwd(pv, x, y):
-        # the actual bench shape: vmap over `workers` grads of `batch` each
-        xs = x.reshape(workers, batch, 32, 32, 3)
-        ys = y.reshape(workers, batch)
-        gs = jax.vmap(lambda xx, yy: fwd_bwd(pv, xx, yy))(xs, ys)
-        return jnp.sum(gs, 0)
+        @jax.jit
+        def fwd_bwd(pv, x, y):
+            p = unravel(pv)
+            (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, {"x": x, "y": y})
+            g, _ = jax.flatten_util.ravel_pytree(grads)
+            return g
+
+        @jax.jit
+        def per_worker_fwd_bwd(pv, x, y):
+            # the actual bench shape: vmap over `workers` grads of `batch` each
+            xs = x.reshape(workers, batch, 32, 32, 3)
+            ys = y.reshape(workers, batch)
+            gs = jax.vmap(lambda xx, yy: fwd_bwd(pv, xx, yy))(xs, ys)
+            return jnp.sum(gs, 0)
 
     from commefficient_tpu.ops.countsketch import unsketch_dense
     from commefficient_tpu.ops.topk import topk_threshold_dense
@@ -123,8 +162,10 @@ def main():
     scatter_j = jax.jit(lambda i, va: jnp.zeros(d, jnp.float32).at[i].set(va))
 
     r = args.reps
-    timeit(f"fwd+bwd batch {workers*batch} (monolithic)", fwd_bwd, vec, x, y, reps=r)
-    t_modelw = timeit(f"fwd+bwd {workers}x{batch} (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
+    t_modelw = 0.0
+    if not args.d:
+        timeit(f"fwd+bwd batch {workers*batch} (monolithic)", fwd_bwd, vec, x, y, reps=r)
+        t_modelw = timeit(f"fwd+bwd {workers}x{batch} (vmap per-worker)", per_worker_fwd_bwd, vec, x, y, reps=r)
 
     # -- sketch/unsketch phase split, BOTH backends ------------------------
     # (the r5 VERDICT gap is a kernel property: the einsum path pays the
@@ -162,6 +203,56 @@ def main():
             timeit("sketch_sparse k=50k (scatter)", ssp_j, idx, vals, reps=r)
             timeit("dense scatter of k", scatter_j, idx, vals, reps=r)
 
+    # -- server-decode phase lines (PR 6: dense vs sharded vs fused) -------
+    # The dense decode line is the per-chip cost EVERY chip of a
+    # replicated mesh pays redundantly (est_all + threshold + the error
+    # feedback's re-sketch); the sharded line is ONE shard's d/W slice of
+    # the same extraction (estimate_at over offset global hashes +
+    # threshold passes + candidate compaction + the slice sketch_sparse)
+    # — its cross-chip traffic is scalar bisection collectives + one ~W*k
+    # gather, negligible next to the slice work, so the single-device
+    # stand-in here times the real per-chip decode cost. The fused line
+    # swaps the slice estimate for the Pallas estimate_at kernel
+    # (ops/pallas/decode_kernels.py).
+    W = args.shards
+    S = -(-d // W)
+    sidx = jnp.minimum(jnp.arange(S, dtype=jnp.int32), d - 1)
+    table = jax.jit(lambda vv: sketch_vec(spec, vv))(v)
+
+    dense_dec_j = jax.jit(
+        lambda t: sketch_vec(spec, unsketch_dense(spec, t, k))
+    )
+
+    def shard_decode(t):
+        est = estimate_at(spec, t, sidx)
+        sel = topk_threshold_dense(est, k)
+        loc, val = compact_nonzero(sel, k)
+        return sketch_sparse(spec, jnp.minimum(loc, d - 1), val)
+
+    timeit("[decode dense] est_all+threshold+resketch (per chip)",
+           dense_dec_j, table, reps=r)
+    timeit(f"[decode sharded W={W}] per-shard slice "
+           "(est_at+thr+compact+slice-sketch)",
+           jax.jit(shard_decode), table, reps=r)
+    if jax.devices()[0].platform == "tpu" or args.sketch_backend == "pallas":
+        from commefficient_tpu.ops.pallas import estimate_at_pallas
+        from commefficient_tpu.ops.pallas.decode_kernels import (
+            VMEM_TABLE_BYTES,
+        )
+
+        sp_p = spec._replace(backend="pallas")
+        if spec.r * spec.c_actual * 4 > VMEM_TABLE_BYTES:
+            print("[decode fused] table exceeds the kernel's VMEM guard "
+                  f"({spec.r * spec.c_actual * 4 / 2**20:.0f} MiB) — "
+                  "estimate_at_pallas falls back to the gather path at "
+                  "this geometry")
+        timeit(f"[decode fused W={W}] estimate_at_pallas slice",
+               jax.jit(lambda t: estimate_at_pallas(sp_p, t, sidx)),
+               table, reps=r)
+    else:
+        print("[decode fused] pallas slice skipped on non-TPU host "
+              "(pass --sketch_backend pallas to force interpret mode)")
+
     print()
     for backend, (t_sk, t_est, t_unskd) in phase.items():
         total = t_modelw + t_sk + t_unskd + t_sk
@@ -170,6 +261,8 @@ def main():
               f"{t_unskd - t_est:.1f}) + resketch {t_sk:.1f} = {total:.1f} ms"
               f" -> {workers * batch / total * 1e3:,.0f} samples/s "
               f"(bench does {workers * batch}/round)")
+    if args.d:
+        return  # decode-phase-only run (no CV model at this D)
 
     # -- powersgd phase split (PR 2: compress/powersgd.py) -----------------
     # the server-side cost the mode adds per round: matricize + P = M Q +
