@@ -44,24 +44,52 @@ BASELINE_SAMPLES_PER_SEC = 20_000.0
 
 # Peak dense matmul throughput of the bench chip, for the MFU line
 # (VERDICT r3 weak 5: anchor perf to hardware, not to the estimate above).
-# TPU v5e (v5 lite): 197 TFLOP/s bf16 / 394 int8 (public spec). The model
-# stream runs bf16 on the MXU in the default "mixed" mode, so bf16 peak is
-# the right denominator; a chip we don't recognize falls back to v5e's.
-PEAK_FLOPS = {"TPU v5 lite": 197e12, "TPU v5": 459e12, "TPU v4": 275e12}
+# TPU v5e (v5 lite): 197 TFLOP/s bf16 / 394 int8 (public spec). The table
+# itself lives in telemetry/xla_audit.py since the compiled-graph
+# observability PR, so bench, profile_round and the audit share one
+# denominator; a chip we don't recognize falls back to v5e's.
 
 
 def _chip_peak_flops() -> tuple[float, str, bool]:
     """(peak bf16 FLOP/s, device_kind, fallback_used). ADVICE r4: an
     unrecognized chip silently got v5e's peak and the MFU line was wrong
     with no indication — now the kind and any fallback are reported."""
-    import jax
+    from commefficient_tpu.telemetry.xla_audit import chip_peak_flops
 
-    kind = jax.devices()[0].device_kind
-    # longest key first: "TPU v5" must not shadow "TPU v5 lite" (v5e)
-    for name in sorted(PEAK_FLOPS, key=len, reverse=True):
-        if name in kind:
-            return PEAK_FLOPS[name], kind, False
-    return 197e12, kind, True
+    return chip_peak_flops()
+
+
+def _audit_leg(session, ids, batch, sec_per_round):
+    """Audited keys for one bench leg from the COMPILED round artifact
+    (telemetry/xla_audit.py): the compiler's own FLOP count and the
+    derived peak-HBM next to the legacy hand-model numbers, so the two
+    can be diffed across rounds. NB ``cost_analysis()`` reports the
+    PER-DEVICE SPMD module (verified on the 8-dev CPU mesh), so audited
+    MFU is per-device FLOPs over ONE chip's peak — no device-count
+    division (dividing by nd again under-reported multichip legs nd-fold)
+    — and ``audited_flops_per_round`` is the per-device figure, which on
+    replicated sections counts each chip's redundant copy of the work.
+    Failures degrade to an ``audit_error`` key — the measured row must
+    survive a broken analysis. Returns (keys dict, audit | None)."""
+    from commefficient_tpu.telemetry.xla_audit import audited_mfu
+
+    try:
+        audit = session.audit_compiled_round(ids, batch, 0.1)
+    except Exception as e:  # noqa: BLE001
+        return {"audit_error": f"{type(e).__name__}: {e}"[:200]}, None
+    out = {}
+    flops = audit.cost.get("flops")
+    if flops is not None:
+        out["audited_flops_per_round"] = flops
+        if sec_per_round:
+            peak, _, _ = _chip_peak_flops()
+            out["audited_mfu"] = round(
+                audited_mfu(flops, sec_per_round, peak), 4
+            )
+    if audit.memory.get("peak_hbm_bytes") is not None:
+        out["audited_peak_hbm_bytes"] = audit.memory["peak_hbm_bytes"]
+    out["audited_collective_bytes"] = audit.collectives["total_bytes"]
+    return out, audit
 
 
 def resnet9_train_flops_per_sample() -> float:
@@ -104,7 +132,7 @@ def _measure_gpt2(mode: str, n_rounds: int = 10, sketch_backend: str = "einsum")
     sketch 5x5M (the BASELINE #4 shape) or uncompressed. ``sketch_backend``
     picks the CountSketch kernel realization (einsum | pallas) — the r5+
     sketch-round gap is a kernel property, so the bench carries both.
-    Returns (tokens_per_sec, mfu, seconds_per_round)."""
+    Returns (tokens_per_sec, mfu, seconds_per_round, audited-keys dict)."""
     import jax
     import jax.numpy as jnp
 
@@ -163,13 +191,15 @@ def _measure_gpt2(mode: str, n_rounds: int = 10, sketch_backend: str = "einsum")
     client_ids = jnp.arange(W, dtype=jnp.int32)
     state, round_fn = session.state, session.round_fn
     lr = jnp.float32(0.1)
+    from commefficient_tpu.utils.profiling import fence
+
     for _ in range(3):  # compile + warm both donated-buffer layouts
         state, m = round_fn(state, client_ids, batch, lr)
-        assert np.isfinite(float(m["loss"]))
+        assert np.isfinite(fence(m["loss"]))
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, m = round_fn(state, client_ids, batch, lr)
-    assert np.isfinite(float(m["loss"]))  # fence
+    assert np.isfinite(fence(m["loss"]))  # scalar-fetch fence
     dt = time.perf_counter() - t0
     d = int(ravel_params(params)[0].size)
     tokens = n_rounds * W * B * N * T  # every candidate's tokens do compute
@@ -181,7 +211,12 @@ def _measure_gpt2(mode: str, n_rounds: int = 10, sketch_backend: str = "einsum")
     mfu = tps * gpt2_flops_per_token(d, gcfg.n_layer, gcfg.n_embd, T) / (
         peak * nd
     )
-    return tps, mfu, dt / n_rounds
+    # audited twin of the hand-model numbers, from the compiled artifact
+    # (one extra AOT compile per leg — tracked perf beats bench wall-clock)
+    audit_keys, _ = _audit_leg(
+        session, np.arange(W, dtype=np.int32), batch, dt / n_rounds
+    )
+    return tps, mfu, dt / n_rounds, audit_keys
 
 
 def _headline_cfg():
@@ -209,8 +244,11 @@ def _headline_cfg():
     )
 
 
-def _measure(cfg, n_rounds: int = 20) -> float:
-    """samples/s of the full federated round under ``cfg`` (one chip)."""
+def _measure(cfg, n_rounds: int = 20, audit_box: dict = None) -> float:
+    """samples/s of the full federated round under ``cfg`` (one chip).
+    ``audit_box``: a dict to fill with the leg's audited keys + the
+    CompiledRoundAudit itself (headline leg only — matrix legs skip the
+    extra AOT compile)."""
     import jax
     import jax.numpy as jnp
 
@@ -262,17 +300,27 @@ def _measure(cfg, n_rounds: int = 20) -> float:
     # compile + warmup: the first TWO calls compile (donated-buffer layouts
     # differ between the fresh state and the returned state), so warm both.
     # NB: block_until_ready is unreliable through the axon tunnel; a scalar
-    # fetch is the only trustworthy fence.
+    # fetch is the only trustworthy fence (utils.profiling.fence does both).
+    from commefficient_tpu.utils.profiling import fence
+
     for i in range(3):
         state, m = round_fn(state, ids, data, lr, env=envs[i])
-        assert np.isfinite(float(m["loss"]))
+        assert np.isfinite(fence(m["loss"]))
 
     t0 = time.perf_counter()
     for i in range(n_rounds):
         state, m = round_fn(state, ids, data, lr, env=envs[3 + i])
-    assert np.isfinite(float(m["loss"]))  # fence
+    assert np.isfinite(fence(m["loss"]))
     dt = time.perf_counter() - t0
-    return n_rounds * workers * batch / dt
+    sps = n_rounds * workers * batch / dt
+    if audit_box is not None:
+        keys, audit = _audit_leg(
+            session, np.asarray(ids), data, dt / n_rounds
+        )
+        audit_box.update(keys)
+        audit_box["_audit"] = audit
+        audit_box["_cfg"] = cfg
+    return sps
 
 
 def main():
@@ -353,7 +401,10 @@ def main():
             print(json.dumps({"metric": name, "value": rows[name],
                               "unit": "samples/s"}))
 
-    headline = _measure(_headline_cfg())
+    audit_box: dict = {}
+    headline = _measure(_headline_cfg(), audit_box=audit_box)
+    headline_audit = audit_box.pop("_audit", None)
+    headline_cfg = audit_box.pop("_cfg", None)
     peak, chip, assumed = _chip_peak_flops()
     mfu = headline * resnet9_train_flops_per_sample() / peak
     # GPT-2 line (VERDICT r4 weak 5 / item 8): language-scale perf was
@@ -417,13 +468,19 @@ def main():
             )
         for m, backend, key in legs:
             try:
-                tps, gmfu, spr = _measure_gpt2(m, sketch_backend=backend)
+                tps, gmfu, spr, audit_keys = _measure_gpt2(
+                    m, sketch_backend=backend
+                )
             except Exception as e:  # noqa: BLE001
                 gpt2[f"{key}_error"] = f"{type(e).__name__}: {e}"[:200]
                 continue
             gpt2[f"{key}_tokens_per_sec"] = round(tps, 1)
             gpt2[f"{key}_mfu"] = round(gmfu, 4)
             gpt2[f"{key}_sec_per_round"] = round(spr, 4)
+            for ak, av in audit_keys.items():
+                # audited per-leg FLOPs / peak-HBM / MFU from the compiled
+                # artifact, next to the hand-model numbers above
+                gpt2[f"{key}_{ak}"] = av
         for key in ("gpt2_sketch", "gpt2_sketch_pallas", "gpt2_powersgd",
                     "gpt2_sketch_sharded"):
             num = gpt2.get(f"{key}_tokens_per_sec")
@@ -436,6 +493,8 @@ def main():
             )
             if num is not None and den:
                 gpt2[f"{key}_vs_uncompressed"] = round(num / den, 4)
+    import jaxlib
+
     line = {
         "metric": "fed_resnet9_sketch_train_samples_per_sec_per_chip",
         "value": round(headline, 2),
@@ -446,17 +505,34 @@ def main():
         # vs_baseline's A100-class estimate (VERDICT r3 weak 5)
         "mfu": round(mfu, 4),
         "chip": chip,
+        # run provenance, so trajectory comparisons (scripts/
+        # check_bench_regression.py) are apples-to-apples across hosts
+        "devices": len(jax.devices()),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        # audited twin of mfu/headline from the compiled round artifact
+        # (telemetry/xla_audit.py; `audit_error` when it degraded)
+        **audit_box,
         **gpt2,
     }
     if assumed:
         # MFU denominator is a guess on this hardware — say so in-band
         line["peak_flops_assumed"] = peak
+    if headline_audit is not None:
+        # the schema-valid perf_report.json artifact for the headline
+        # round (acceptance: bench writes one; checker-validated)
+        try:
+            headline_audit.write(".", generated_by="bench",
+                                 cfg=headline_cfg)
+        except Exception as e:  # noqa: BLE001
+            line["perf_report_error"] = f"{type(e).__name__}: {e}"[:200]
     if args.matrix:
         rows["sketch_fused_headline"] = round(headline, 2)
         rows["mfu_model_flops"] = round(mfu, 4)
         rows["chip"] = chip
         if assumed:  # same in-band marker as the headline line
             rows["peak_flops_assumed"] = peak
+        rows.update(audit_box)
         rows.update(gpt2)
         with open("BENCH_MATRIX.json", "w") as f:
             json.dump(rows, f, indent=2)

@@ -193,6 +193,22 @@ class FederatedSession:
         # restore re-syncs it via sync_round_clock).
         self.fedsim_env = build_environment(cfg)
         self._round_clock = 0
+        # retrace sentinel (telemetry/xla_audit.py): counts traces of the
+        # jitted round via the builders' trace_hook — pure python at trace
+        # time, zero traced ops, so the compiled program is bit-identical
+        # (pinned by tests/test_xla_audit.py). `xla/retraces` rides the
+        # drained metrics at telemetry_level >= 1; cfg.max_retraces makes
+        # a silent mid-run recompile a hard RetraceError naming the
+        # argument-signature diff.
+        from commefficient_tpu.telemetry.xla_audit import RetraceSentinel
+
+        self.retrace_sentinel = RetraceSentinel(
+            max_retraces=cfg.max_retraces, name="round_fn"
+        )
+        # host-side phase-span recorder (telemetry/spans.py); a train loop
+        # attaches one at telemetry_level >= 1 — None keeps every span
+        # site on the zero-cost fast path.
+        self.spans = None
         self.host_vel = self.host_err = None
         self._dev_data = self._round_idx_fn = None
         if cfg.fsdp:
@@ -207,7 +223,8 @@ class FederatedSession:
 
             self.state = init_fsdp_state(cfg, vec, self.spec, self.mesh)
             self.round_fn = build_fsdp_round_fn(
-                cfg, loss_fn, unravel, self.mesh, self.spec, d=self.grad_size
+                cfg, loss_fn, unravel, self.mesh, self.spec,
+                d=self.grad_size, trace_hook=self.retrace_sentinel.hook,
             )
         else:
             self.state = init_state(cfg, vec, self.spec)
@@ -217,7 +234,8 @@ class FederatedSession:
                 if needs_client_err(cfg):
                     self.host_err = np.zeros((cfg.num_clients, self.grad_size), np.float32)
             self.round_fn = build_round_fn(
-                cfg, loss_fn, unravel, self.mesh, self.spec, d=self.grad_size
+                cfg, loss_fn, unravel, self.mesh, self.spec,
+                d=self.grad_size, trace_hook=self.retrace_sentinel.hook,
             )
         # eval_fn: a prebuilt (params_vec, batch) -> metric-sums step — the
         # TP/SP eval path (tensor.build_tp_eval_fn) when the model needs the
@@ -318,7 +336,12 @@ class FederatedSession:
                 }
             return raw_round(state, client_ids, batch, lr, env=env)
 
-        self._round_idx_fn = jax.jit(round_idx_fn, donate_argnums=(0,))
+        # the retrace sentinel watches the OUTER jitted program (the raw
+        # round inside it is traced as part of the same trace — hooking
+        # both would double-count every legitimate compile)
+        self._round_idx_fn = jax.jit(
+            self.retrace_sentinel.wrap(round_idx_fn), donate_argnums=(0,)
+        )
 
     # -- fedsim (fedsim/: availability masking + chaos) --------------------
     def sync_round_clock(self) -> None:
@@ -353,44 +376,76 @@ class FederatedSession:
         cnt = jax.device_put(jnp.float32(env.live_count), self._replicated)
         return (live, corr, cnt), dict(env.stats)
 
+    # -- host-side round observability (telemetry) -------------------------
+    def _span(self, name: str, fence=None):
+        """Phase-span context (telemetry/spans.py) — a nullcontext yielding
+        None unless a train loop attached a recorder (level >= 1)."""
+        if self.spans is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        return self.spans.span(name, fence=fence)
+
+    def _host_round_stats(self, fs_stats: dict) -> dict:
+        """Host scalars riding this round's metric dict: the fedsim stats
+        plus (level >= 1) the retrace sentinel's count — constant key set
+        across an epoch, as pack_metric_dicts requires."""
+        stats = dict(fs_stats)
+        if self.cfg.telemetry_level >= 1:
+            stats["xla/retraces"] = float(self.retrace_sentinel.retraces)
+        return stats
+
     def train_round_indices(self, client_ids, idx, plan, lr: float, env=None):
         """Run one round from device-resident data (see ``attach_data``)."""
-        ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
-        idxd = jax.device_put(
-            jnp.asarray(np.asarray(idx, np.int32)), self._batch_sharding
-        )
-        pl = (
-            tuple(
-                jax.device_put(jnp.asarray(np.asarray(a)), self._replicated)
-                for a in plan
+        with self._span("device_put"):
+            ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
+            idxd = jax.device_put(
+                jnp.asarray(np.asarray(idx, np.int32)), self._batch_sharding
             )
-            if plan
-            else ()
-        )
-        fs_env, fs_stats = self._fedsim_round_env(env)
-        self.state, metrics = self._round_idx_fn(
-            self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr),
-            env=fs_env,
-        )
+            pl = (
+                tuple(
+                    jax.device_put(jnp.asarray(np.asarray(a)), self._replicated)
+                    for a in plan
+                )
+                if plan
+                else ()
+            )
+        with self._span("fedsim_env"):
+            fs_env, fs_stats = self._fedsim_round_env(env)
+        with self._span("round_dispatch") as sp:
+            self.state, metrics = self._round_idx_fn(
+                self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr),
+                env=fs_env,
+            )
+            if sp is not None:
+                sp.fence(metrics["loss"])
         self._round_clock += 1
-        return {**metrics, **fs_stats} if fs_stats else metrics
+        stats = self._host_round_stats(fs_stats)
+        return {**metrics, **stats} if stats else metrics
 
     # -- train ------------------------------------------------------------
     def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray],
                     lr: float, env=None):
         cids = np.asarray(client_ids)
-        ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
-        dev_batch = jax.tree.map(
-            lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding), batch
-        )
-        lr = jnp.float32(lr)
-        fs_env, fs_stats = self._fedsim_round_env(env)
-        if not self.cfg.offload_client_state:
-            self.state, metrics = self.round_fn(
-                self.state, ids, dev_batch, lr, env=fs_env
+        with self._span("device_put"):
+            ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
+            dev_batch = jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
+                batch,
             )
+        lr = jnp.float32(lr)
+        with self._span("fedsim_env"):
+            fs_env, fs_stats = self._fedsim_round_env(env)
+        if not self.cfg.offload_client_state:
+            with self._span("round_dispatch") as sp:
+                self.state, metrics = self.round_fn(
+                    self.state, ids, dev_batch, lr, env=fs_env
+                )
+                if sp is not None:
+                    sp.fence(metrics["loss"])
             self._round_clock += 1
-            return {**metrics, **fs_stats} if fs_stats else metrics
+            stats = self._host_round_stats(fs_stats)
+            return {**metrics, **stats} if stats else metrics
         vel_rows = (
             jax.device_put(jnp.asarray(self.host_vel[cids]), self._batch_sharding)
             if self.host_vel is not None
@@ -401,15 +456,19 @@ class FederatedSession:
             if self.host_err is not None
             else ()
         )
-        self.state, metrics, new_vel, new_err = self.round_fn(
-            self.state, ids, dev_batch, lr, vel_rows, err_rows, env=fs_env
-        )
+        with self._span("round_dispatch") as sp:
+            self.state, metrics, new_vel, new_err = self.round_fn(
+                self.state, ids, dev_batch, lr, vel_rows, err_rows, env=fs_env
+            )
+            if sp is not None:
+                sp.fence(metrics["loss"])
         self._round_clock += 1
         if self.host_vel is not None:
             self.host_vel[cids] = np.asarray(new_vel)
         if self.host_err is not None:
             self.host_err[cids] = np.asarray(new_err)
-        return {**metrics, **fs_stats} if fs_stats else metrics
+        stats = self._host_round_stats(fs_stats)
+        return {**metrics, **stats} if stats else metrics
 
     # -- eval -------------------------------------------------------------
     def _put_eval_batch(self, b: Dict[str, np.ndarray]):
@@ -478,6 +537,73 @@ class FederatedSession:
         if self.cfg.fsdp:
             vec = vec[: self.grad_size]
         return self.unravel(vec)
+
+    # -- compiled-graph audit (telemetry/xla_audit.py) ---------------------
+    def audit_compiled_round(self, client_ids, batch, lr: float, env=None):
+        """AOT-compile the round for ``batch``'s signature and audit the
+        artifact: XLA cost/memory analyses + the HLO collective walk,
+        cross-checked against this session's ledger accounting and (on the
+        sharded sketch decode) the PR-6 ``<= W*k`` all-gather bound.
+        Returns a ``telemetry.CompiledRoundAudit``.
+
+        Costs one extra XLA compile (the AOT ``compile()`` artifact is
+        separate from the jit call cache). The ``lower()`` TRACE, however,
+        is shared with the call path on this jax, so it counts as the
+        round's expected first trace — audit with the run's real first
+        batch (the train entries pass ``sampler.sample_round(0)``) and the
+        sentinel stays at zero retraces for a clean run. Audits the
+        host-batch round — the device-resident index round wraps the same
+        program plus an in-graph gather, so this is the representative
+        artifact for both entry paths. Pure observer: no state, round
+        clock, or donation side effects.
+        """
+        from commefficient_tpu.telemetry.xla_audit import (
+            CompiledRoundAudit,
+            ledger_tolerance,
+        )
+
+        cids = np.asarray(client_ids)
+        ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
+        dev_batch = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
+            batch,
+        )
+        args = [self.state, ids, dev_batch, jnp.float32(lr)]
+        if self.cfg.offload_client_state and not self.cfg.fsdp:
+            args.append(
+                jax.device_put(jnp.asarray(self.host_vel[cids]),
+                               self._batch_sharding)
+                if self.host_vel is not None else ()
+            )
+            args.append(
+                jax.device_put(jnp.asarray(self.host_err[cids]),
+                               self._batch_sharding)
+                if self.host_err is not None else ()
+            )
+        fs_env, _ = self._fedsim_round_env(env)
+        lowered = self.round_fn.lower(*args, env=fs_env)
+        compiled = lowered.compile()
+        W = self._n_mesh_devices
+        # capability, not a mode string (scripts/check_mode_dispatch.py):
+        # only compressors with a server-decode strategy knob report one
+        is_sketch = (
+            not self.cfg.fsdp and self.compressor.supports_sharded_decode
+        )
+        sharded = is_sketch and self.sketch_decode_resolved == "sharded"
+        up = self.bytes_per_round()["upload_bytes"]
+        return CompiledRoundAudit.from_compiled(
+            compiled,
+            engine="fsdp" if self.cfg.fsdp else "replicated",
+            mode=self.cfg.mode,
+            sketch_decode=self.sketch_decode_resolved if is_sketch else None,
+            grad_size=self.grad_size,
+            workers_mesh=W,
+            ledger_up_bytes=up,
+            wk_bound=W * self.cfg.k if sharded else None,
+            tolerance_bytes=ledger_tolerance(
+                up, sharded=sharded, workers=W, k=self.cfg.k
+            ),
+        )
 
     def bytes_per_round(self) -> Dict[str, int]:
         """Upload/download bytes per participating client (BASELINE.md

@@ -210,12 +210,15 @@ def build_fsdp_round_fn(
     spec: Optional[CountSketch] = None,
     *,
     d: int,
+    trace_hook=None,
 ):
     """Compile the FSDP per-round step: same external contract as
     ``build_round_fn``'s non-offloaded product — ``round_fn(state,
     client_ids [W], batch {k: [W, ...]}, lr) -> (new_state, metrics)`` —
     with ``state.params_vec`` (and dense momentum/error) sharded [Dp]
-    arrays instead of replicated [D] ones.
+    arrays instead of replicated [D] ones. ``trace_hook``: same contract
+    as build_round_fn's (telemetry retrace sentinel; trace-time only,
+    zero traced ops).
     """
     comp = get_compressor(cfg, d=d, spec=spec)
     _validate_fsdp(cfg, comp)
@@ -346,6 +349,8 @@ def build_fsdp_round_fn(
     )
 
     def round_fn(state: FedState, client_ids, batch, lr, env=()):
+        if trace_hook is not None:  # runs at trace time only (no ops)
+            trace_hook(state, client_ids, batch, lr, env=env)
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
         fs = ()
         if use_fedsim:

@@ -221,6 +221,7 @@ def build_round_fn(
     _jit: bool = True,
     *,
     d: Optional[int] = None,
+    trace_hook: Optional[Callable] = None,
 ):
     """Compile the per-round step.
 
@@ -232,6 +233,10 @@ def build_round_fn(
       d: flat param dimension, REQUIRED (compressor geometry, e.g.
         powersgd's matricization) — pass ``ravel_params(params)[0].size``.
         Keyword-only so legacy positional call sites fail loudly.
+      trace_hook: optional callable invoked with the round's arguments at
+        TRACE time only (telemetry.RetraceSentinel.hook) — a pure python
+        side effect, so the traced program is bit-identical with or
+        without it; counts/hard-fails silent mid-run retraces.
     Returns:
       With HBM-resident client state (default):
         ``round_fn(state, client_ids [W], batch {k: [W, ...]}, lr) ->
@@ -399,6 +404,9 @@ def build_round_fn(
 
     def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(),
                  err_rows=(), env=()):
+        if trace_hook is not None:  # runs at trace time only (no ops)
+            trace_hook(state, client_ids, batch, lr, vel_rows, err_rows,
+                       env=env)
         rng = jax.random.fold_in(jax.random.key(cfg.seed), state.step)
         fs = ()
         if use_fedsim:

@@ -23,6 +23,18 @@ package makes both observable per round, in three pillars:
     ``DivergenceError`` naming the first bad round instead of training
     onward on NaNs.
 
+Since the compiled-graph observability PR two more pillars measure the
+system FROM THE COMPILED ARTIFACT instead of trusting analytic models:
+
+  * ``xla_audit`` — AOT cost/memory analyses + an HLO collective walk of
+    the compiled round, cross-checked against the CommLedger accounting
+    and the PR-6 W*k all-gather bound (``perf_report.json`` + ``xla/*``
+    scalars), and the ``RetraceSentinel`` that counts/hard-fails silent
+    mid-run recompiles naming the argument-signature diff.
+  * ``spans`` — host-side Chrome-trace phase spans (data load / fedsim
+    env / device_put / round dispatch / drain / checkpoint) dumped as
+    ``spans_<step>.json`` next to the StepProfiler's XLA traces.
+
 Telemetry levels (``--telemetry_level``):
 
   0 — off (default). Zero traced ops, zero host work; bit-identical rounds.
@@ -52,15 +64,51 @@ from commefficient_tpu.telemetry.flight import (
     jsonable_tree,
 )
 from commefficient_tpu.telemetry.ledger import CommLedger, run_metadata
+from commefficient_tpu.telemetry.spans import PhaseSpans
+from commefficient_tpu.telemetry.xla_audit import (
+    CompiledRoundAudit,
+    RetraceError,
+    RetraceSentinel,
+    audited_mfu,
+    chip_peak_flops,
+    collective_audit,
+)
 
-# versioned schema shared by metrics.jsonl headers, flight_*.json and
-# comm_ledger.json (scripts/check_telemetry_schema.py validates against it).
+# versioned schema shared by metrics.jsonl headers, flight_*.json,
+# comm_ledger.json, perf_report.json and spans_*.json
+# (scripts/check_telemetry_schema.py validates against it).
 # v2 (fedsim PR): fedsim/* scalar namespace, the ledger's masked live-byte
 # accounting (live_client_rounds/avail_client_rounds + their exactness
 # invariant), and the flight dump's participation_history window.
-SCHEMA_VERSION = 2
+# v3 (compiled-graph observability PR): the xla/* scalar namespace
+# (collective bytes, ledger-vs-HLO delta, retrace count, audited FLOPs/
+# peak-HBM), the perf_report.json artifact (xla_audit.py) with its
+# checker-enforced sharded-decode collective invariant, spans_*.json
+# Chrome-trace phase spans, and the header/flight "artifacts" block
+# linking a run to its StepProfiler logdir + perf report.
+SCHEMA_VERSION = 3
 
 TELEMETRY_LEVELS = (0, 1, 2)
+
+
+def run_artifacts(cfg, logdir: str) -> dict:
+    """The artifact-linking block shared by the metrics.jsonl run header
+    and flight-record metadata: where this run's profiling evidence lives
+    (StepProfiler trace logdir, the compiled-round perf_report.json), so a
+    divergence dump points straight at its perf context. The perf-report
+    link is only advertised when the audit will actually run
+    (``cfg.perf_audit``; accuracy_run opts out, for instance) — though a
+    startup audit that later degrades still leaves the path absent, so
+    consumers should stat before reading."""
+    out = {}
+    if getattr(cfg, "profile_dir", ""):
+        out["profile_dir"] = cfg.profile_dir
+    if (logdir and getattr(cfg, "telemetry_level", 0) >= 1
+            and getattr(cfg, "perf_audit", True)):
+        import os
+
+        out["perf_report"] = os.path.join(logdir, "perf_report.json")
+    return out
 
 
 def build_telemetry_riders(cfg, session, writer):
@@ -81,9 +129,55 @@ def build_telemetry_riders(cfg, session, writer):
         cfg, logdir=writer.logdir,
         extra_meta={"grad_size": session.grad_size,
                     "mesh": dict(zip(session.mesh.axis_names,
-                                     session.mesh.devices.shape))},
+                                     session.mesh.devices.shape)),
+                    # link the dump to its profiling artifacts: a
+                    # divergence post-mortem starts from the flight record
+                    # and must be able to find the trace + perf report
+                    "artifacts": run_artifacts(cfg, writer.logdir)},
     )
     return ledger, flight
+
+
+def build_perf_observability(cfg, session, sampler, writer, lr0,
+                             generated_by: str):
+    """(spans, audit) for a train loop — the ONE perf-observability wiring
+    both entries share (same discipline as build_telemetry_riders).
+
+    At telemetry level >= 1 with a writer: attaches a PhaseSpans recorder
+    to the session (host phase spans -> spans_<step>.json) and — unless
+    ``cfg.perf_audit`` is off — AOT-compiles the round for the run's REAL
+    first batch (``sampler.sample_round(0)``; its trace seeds the retrace
+    sentinel's expected first signature) and writes ``perf_report.json``
+    plus the one-shot ``xla/*`` scalars. The audit must never kill a run:
+    any failure degrades to a console note. Returns (None, None) below
+    level 1."""
+    if getattr(cfg, "telemetry_level", 0) < 1 or writer is None:
+        return None, None
+    spans = PhaseSpans(writer.logdir)
+    session.spans = spans
+    audit = None
+    if getattr(cfg, "perf_audit", True):
+        try:
+            ids, batch = sampler.sample_round(0)
+            L = getattr(cfg, "round_microbatches", 0)
+            if L:  # fedavg [W, L, B/L, ...] convention (cv_train loop)
+                batch = {
+                    k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                    for k, v in batch.items()
+                }
+            audit = session.audit_compiled_round(ids, batch, lr0)
+            path = audit.write(writer.logdir, generated_by=generated_by,
+                               cfg=cfg)
+            for name, val in audit.scalars().items():
+                writer.scalar(name, val, 0)
+            writer.flush()
+            print(audit.describe())
+            print(f"perf report: {path}")
+        except Exception as e:  # noqa: BLE001 — observability never kills
+            audit = None
+            print(f"compiled-round audit skipped "
+                  f"({type(e).__name__}: {e})")
+    return spans, audit
 
 
 def record_crash(flight, exc) -> None:
@@ -97,13 +191,22 @@ __all__ = [
     "SCHEMA_VERSION",
     "TELEMETRY_LEVELS",
     "CommLedger",
+    "CompiledRoundAudit",
     "DivergenceError",
     "FlightRecorder",
+    "PhaseSpans",
+    "RetraceError",
+    "RetraceSentinel",
+    "audited_mfu",
+    "build_perf_observability",
     "build_telemetry_riders",
+    "chip_peak_flops",
+    "collective_audit",
     "jsonable_scalar",
     "jsonable_tree",
     "nonfinite_sentinel",
     "record_crash",
+    "run_artifacts",
     "round_diagnostics",
     "round_diagnostics_sparse",
     "run_metadata",

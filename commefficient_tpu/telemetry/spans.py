@@ -1,0 +1,176 @@
+"""Host-side phase spans — where does the wall-clock of a round GO?
+
+The ``StepProfiler`` answers "what is the device doing" (a real XLA trace);
+nothing answered "what is the HOST doing around it" — data load, fedsim
+environment realization, device_put, round dispatch, metric drain,
+checkpoint writes. Those phases are exactly where tunneled-TPU runs lose
+time invisibly (a 310 ms H2D batch copy is a host phase, not a device op).
+``PhaseSpans`` records them as Chrome-trace/Perfetto "complete" events and
+dumps ``spans_<step>.json`` into the run dir, loadable in
+``chrome://tracing`` / https://ui.perfetto.dev next to the StepProfiler's
+XLA traces.
+
+Fencing discipline (the part that keeps level >= 1 cheap): host timestamps
+are recorded for EVERY round — two ``perf_counter`` calls and a dict per
+span, no device interaction — but the round-dispatch span only *fences*
+(scalar-fetch sync, the only trustworthy fence through an axon tunnel)
+inside a short steady-state window, the same ``MIN_WARMUP_STEPS``-clamped
+window the StepProfiler uses. Outside the window the dispatch span
+honestly measures dispatch (async enqueue) time; inside it, the fenced
+span is the real per-round device+host latency. At telemetry level 0 the
+train loops construct no recorder at all — zero host work, and nothing in
+the jitted program either way (spans are pure host code).
+
+Format: ``{"schema_version", "kind": "spans", "displayTimeUnit",
+"traceEvents": [{"name", "ph": "X", "ts", "dur", "pid", "tid",
+"args": {"step", "fenced"}}]}`` — ts/dur in microseconds since the
+recorder was constructed (Chrome trace convention). Validated by
+scripts/check_telemetry_schema.py (schema v3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Optional
+
+from commefficient_tpu.utils.profiling import MIN_WARMUP_STEPS
+
+# ring bound on recorded events: a long run records ~4-6 events per round;
+# the most recent ~1.3k rounds of host phases are plenty for a post-mortem
+# and keep the dump a few hundred KB at worst
+MAX_EVENTS = 8192
+
+
+class _SpanHandle:
+    """Yielded by ``PhaseSpans.span``: lets the block arm a fence on a
+    value it only produces mid-block (the dispatched round's metrics)."""
+
+    __slots__ = ("fence_target",)
+
+    def __init__(self):
+        self.fence_target = None
+
+    def fence(self, x) -> None:
+        self.fence_target = x
+
+
+class PhaseSpans:
+    """Chrome-trace span recorder for the train loop's host phases.
+
+    Inert when ``logdir`` is falsy (the train loops pass "" below
+    telemetry level 1). ``step(i)`` marks round starts (drives the fenced
+    window); ``span(name, fence=...)`` brackets one phase; ``wrap_iter``
+    times an iterator's ``next()`` (the data-load phase); ``close()``
+    dumps ``spans_<step>.json``.
+    """
+
+    def __init__(self, logdir: str, start_step: int = 5, num_steps: int = 3):
+        self.logdir = logdir
+        self.enabled = bool(logdir)
+        self.start = max(start_step, MIN_WARMUP_STEPS)
+        self.stop_at = self.start + num_steps
+        self._step = -1
+        self._t0 = time.perf_counter()
+        self.events: deque = deque(maxlen=MAX_EVENTS)
+        self._first_step: Optional[int] = None
+        self._dumped: Optional[str] = None
+
+    # -- round clock -------------------------------------------------------
+    def step(self, step_idx: int) -> None:
+        self._step = int(step_idx)
+        if self.enabled and self._first_step is None:
+            self._first_step = self._step
+
+    @property
+    def in_window(self) -> bool:
+        """True while fenced dispatch spans are wanted (steady-state
+        window, post compile+warmup — same clamp as StepProfiler)."""
+        return self.start <= self._step < self.stop_at
+
+    def resume_at(self, resume_step: int) -> None:
+        """Shift the fenced window past a checkpoint resume (the resumed
+        process recompiles from scratch; mirrors StepProfiler.resume_at)."""
+        floor = resume_step + MIN_WARMUP_STEPS
+        if floor > self.start:
+            n = self.stop_at - self.start
+            self.start, self.stop_at = floor, floor + n
+
+    # -- recording ---------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, fence=None):
+        """Record one phase. Yields a handle whose ``fence(x)`` arms a
+        scalar-fetch sync on ``x`` before the span closes (for targets only
+        known inside the block, e.g. the dispatched round's metrics);
+        ``fence=`` arms it up front. The sync only actually runs inside the
+        steady-state window, so per-round overhead outside it stays at two
+        perf_counter calls. Yields None when the recorder is disabled."""
+        if not self.enabled:
+            yield None
+            return
+        h = _SpanHandle()
+        h.fence_target = fence
+        t0 = time.perf_counter()
+        fenced = False
+        try:
+            yield h
+            if h.fence_target is not None and self.in_window:
+                from commefficient_tpu.utils.profiling import fence as _fence
+
+                _fence(h.fence_target)
+                fenced = True
+        finally:
+            t1 = time.perf_counter()
+            self.events.append({
+                "name": name,
+                "ph": "X",
+                "ts": (t0 - self._t0) * 1e6,
+                "dur": (t1 - t0) * 1e6,
+                "pid": 0,
+                "tid": 0,
+                "args": {"step": self._step, "fenced": fenced},
+            })
+
+    def wrap_iter(self, it, name: str = "data_load"):
+        """Yield from ``it``, recording each ``next()`` as one span (the
+        data-load/prefetch-wait phase). Transparent when disabled."""
+        if not self.enabled:
+            yield from it
+            return
+        it = iter(it)
+        while True:
+            with self.span(name):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            yield item
+
+    # -- dump --------------------------------------------------------------
+    def dump(self) -> Optional[str]:
+        """Write ``spans_<step>.json`` (step = first recorded round);
+        returns the path, or None when disabled/empty."""
+        if not self.enabled or not self.events:
+            return None
+        os.makedirs(self.logdir, exist_ok=True)
+        from commefficient_tpu.telemetry import SCHEMA_VERSION, jsonable_tree
+
+        step = self._first_step if self._first_step is not None else 0
+        path = os.path.join(self.logdir, f"spans_{step}.json")
+        payload = {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "spans",
+            "displayTimeUnit": "ms",
+            "window": [self.start, self.stop_at],
+            "traceEvents": list(self.events),
+        }
+        with open(path, "w") as f:
+            json.dump(jsonable_tree(payload), f, allow_nan=False)
+        self._dumped = path
+        return path
+
+    def close(self) -> Optional[str]:
+        return self.dump()

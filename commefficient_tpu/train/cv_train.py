@@ -181,7 +181,11 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     table = table or TableLogger()
     timer = Timer()
-    from commefficient_tpu.telemetry import build_telemetry_riders, record_crash
+    from commefficient_tpu.telemetry import (
+        build_perf_observability,
+        build_telemetry_riders,
+        record_crash,
+    )
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
@@ -190,6 +194,13 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     # recorder dumps flight_<step>.json + raises DivergenceError on a
     # non-finite round (see telemetry/ package docstring)
     ledger, flight = build_telemetry_riders(cfg, session, writer)
+    # perf observability (level >= 1): host phase spans + the compiled-
+    # round XLA audit -> perf_report.json + xla/* scalars (the audit's
+    # AOT trace doubles as the round's first compile-cache fill)
+    spans, _ = build_perf_observability(
+        cfg, session, sampler, writer, float(lr_fn(0)),
+        generated_by="train/cv_train",
+    )
     val = {}
     step = 0
     if checkpointer is not None and cfg.resume:
@@ -197,6 +208,8 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
         if restored is not None:
             step = restored
             profiler.resume_at(step)  # clamp the trace window post-resume
+            if spans is not None:
+                spans.resume_at(step)
             print(f"resumed from checkpoint at round {step}")
     try:
         for epoch in range(step // steps_per_epoch, cfg.num_epochs):
@@ -210,9 +223,14 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 train_correct += float(metrics.get("correct", 0.0))
                 train_count += float(metrics.get("count", 0.0))
 
-            drain = lambda: drain_round_metrics(  # noqa: E731
-                pending, writer, acc, ledger=ledger, flight=flight
-            )
+            def drain():
+                if spans is not None:
+                    with spans.span("metric_drain"):
+                        drain_round_metrics(pending, writer, acc,
+                                            ledger=ledger, flight=flight)
+                else:
+                    drain_round_metrics(pending, writer, acc,
+                                        ledger=ledger, flight=flight)
 
             use_idx = getattr(session, "_dev_data", None) is not None
             rounds = (
@@ -220,11 +238,16 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if use_idx
                 else prefetch(sampler.epoch(epoch))
             )
+            if spans is not None:
+                # times each next() — the data-load/prefetch-wait phase
+                rounds = spans.wrap_iter(rounds, "data_load")
             for round_idx, item in enumerate(rounds):
                 if epoch * steps_per_epoch + round_idx < step:
                     continue  # fast-forward within the resumed epoch
                 lr = float(lr_fn(step))
                 profiler.step(step)
+                if spans is not None:
+                    spans.step(step)
                 if use_idx:
                     client_ids, idx, plan = item
                     metrics = session.train_round_indices(client_ids, idx, plan, lr)
@@ -242,7 +265,11 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if checkpointer is not None:
                     if checkpointer.will_save(step):
                         drain()
-                    checkpointer.maybe_save(session, step)
+                    if spans is not None:
+                        with spans.span("checkpoint"):
+                            checkpointer.maybe_save(session, step)
+                    else:
+                        checkpointer.maybe_save(session, step)
             drain()
             train_time = timer()
             val = session.evaluate(test_ds.eval_batches(eval_batch_size))
@@ -269,6 +296,9 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
         raise
     finally:
         profiler.close()
+        if spans is not None:
+            session.spans = None
+            spans.close()  # dumps spans_<step>.json (crash included)
         if ledger is not None:
             # partial ledgers are still evidence — write on crash too
             ledger.write(writer.logdir)

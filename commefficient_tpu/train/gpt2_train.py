@@ -148,12 +148,24 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     table = table or TableLogger()
     timer = Timer()
-    from commefficient_tpu.telemetry import build_telemetry_riders, record_crash
+    from commefficient_tpu.telemetry import (
+        build_perf_observability,
+        build_telemetry_riders,
+        record_crash,
+    )
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
     # telemetry riders (level >= 1), shared constructor with cv_train
     ledger, flight = build_telemetry_riders(cfg, session, writer)
+    # perf observability (level >= 1), shared constructor with cv_train:
+    # phase spans + compiled-round audit -> perf_report.json. NB the audit
+    # AOT-compiles the round once more — at GPT-2 scale pass
+    # --perf_audit false if that extra compile is unacceptable.
+    spans, _ = build_perf_observability(
+        cfg, session, sampler, writer, float(lr_fn(0)),
+        generated_by="train/gpt2_train",
+    )
     val = {}
     step = 0
     W = cfg.num_workers
@@ -162,6 +174,8 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
         if restored is not None:
             step = restored
             profiler.resume_at(step)  # clamp the trace window post-resume
+            if spans is not None:
+                spans.resume_at(step)
             print(f"resumed from checkpoint at round {step}")
     try:
         for epoch in range(step // steps_per_epoch, cfg.num_epochs):
@@ -176,9 +190,14 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 tr_lm += float(metrics.get("lm_loss", 0.0)) / W
                 tr_mc += float(metrics.get("mc_loss", 0.0)) / W
 
-            drain = lambda: drain_round_metrics(  # noqa: E731
-                pending, writer, acc, ledger=ledger, flight=flight
-            )
+            def drain():
+                if spans is not None:
+                    with spans.span("metric_drain"):
+                        drain_round_metrics(pending, writer, acc,
+                                            ledger=ledger, flight=flight)
+                else:
+                    drain_round_metrics(pending, writer, acc,
+                                        ledger=ledger, flight=flight)
 
             use_idx = getattr(session, "_dev_data", None) is not None
             rounds = (
@@ -186,11 +205,16 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if use_idx
                 else prefetch(sampler.epoch(epoch))
             )
+            if spans is not None:
+                # times each next() — the data-load/prefetch-wait phase
+                rounds = spans.wrap_iter(rounds, "data_load")
             for round_idx, item in enumerate(rounds):
                 if epoch * steps_per_epoch + round_idx < step:
                     continue  # fast-forward within the resumed epoch
                 lr = float(lr_fn(step))
                 profiler.step(step)
+                if spans is not None:
+                    spans.step(step)
                 if use_idx:
                     client_ids, idx, plan = item
                     metrics = session.train_round_indices(client_ids, idx, plan, lr)
@@ -208,7 +232,11 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if checkpointer is not None:
                     if checkpointer.will_save(step):
                         drain()
-                    checkpointer.maybe_save(session, step)
+                    if spans is not None:
+                        with spans.span("checkpoint"):
+                            checkpointer.maybe_save(session, step)
+                    else:
+                        checkpointer.maybe_save(session, step)
             drain()
             train_time = timer()
             val = evaluate_ppl(session, test_ds, eval_batch_size)
@@ -246,6 +274,9 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
         raise
     finally:
         profiler.close()
+        if spans is not None:
+            session.spans = None
+            spans.close()  # dumps spans_<step>.json (crash included)
         if ledger is not None:
             ledger.write(writer.logdir)
     if not val:

@@ -258,6 +258,20 @@ class Config:
     # round records ride in flight_<step>.json when a run goes non-finite
     # (telemetry/flight.py). Active at telemetry_level >= 1.
     flight_window: int = 16
+    # Retrace budget for the jitted round (telemetry/xla_audit.py
+    # RetraceSentinel): None (default) only counts — `xla/retraces` rides
+    # the drained metrics at telemetry_level >= 1; an int N hard-fails
+    # (RetraceError naming the offending argument-signature diff) on the
+    # N+1-th retrace. A mid-run retrace silently recompiles the whole XLA
+    # round — minutes at GPT-2 scale — so perf-critical runs should set 0.
+    # The first trace is the expected compile and never counts.
+    max_retraces: Optional[int] = None
+    # Compiled-round XLA audit (telemetry/xla_audit.py) at train-entry
+    # startup when telemetry_level >= 1: cost/memory analyses + HLO
+    # collective walk -> perf_report.json + xla/* scalars. Costs ONE extra
+    # AOT compile of the round (seconds at CV scale, minutes for GPT-2) —
+    # set false to skip it on huge models where the double compile hurts.
+    perf_audit: bool = True
 
     # --- federated environment simulation (commefficient_tpu/fedsim/;
     # TPU-native — the reference assumes all num_workers arrive every
@@ -459,6 +473,12 @@ class Config:
         if self.flight_window < 1:
             raise ValueError(
                 f"flight_window must be >= 1, got {self.flight_window}"
+            )
+        if self.max_retraces is not None and self.max_retraces < 0:
+            raise ValueError(
+                f"max_retraces must be >= 0 (0 = fail on ANY retrace "
+                f"beyond the first compile) or None (count only), got "
+                f"{self.max_retraces}"
             )
 
     @property

@@ -116,11 +116,19 @@ class MetricsWriter:
         from commefficient_tpu.telemetry import (
             SCHEMA_VERSION,
             jsonable_tree,
+            run_artifacts,
             run_metadata,
         )
 
         rec = {"type": "header", "schema_version": SCHEMA_VERSION,
                **run_metadata(cfg)}
+        if cfg is not None:
+            # v3: link the run to its profiling evidence (StepProfiler
+            # trace logdir, the compiled-round perf_report.json) so a
+            # metrics consumer can find them without guessing paths
+            arts = run_artifacts(cfg, self.logdir)
+            if arts:
+                rec["artifacts"] = arts
         self._jsonl.write(json.dumps(jsonable_tree(rec),
                                      allow_nan=False) + "\n")
         self._jsonl.flush()

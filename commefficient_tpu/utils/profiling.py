@@ -1,13 +1,18 @@
-"""Profiling hooks — ``jax.profiler`` traces around a window of rounds.
+"""Profiling hooks — ``jax.profiler`` traces around a window of rounds,
+plus the shared micro-benchmark helpers (``fence``/``timeit``).
 
 The reference's only tracing is a console Timer around epoch phases
 (SURVEY.md §5 "Tracing/profiling"); the rebuild equivalent is a real XLA
 trace viewable in TensorBoard/Perfetto. ``StepProfiler`` wraps a few
 steady-state rounds (after compile/warmup) so the trace shows the real hot
-path, not compilation.
+path, not compilation. ``fence``/``timeit`` used to live (duplicated) in
+scripts/profile_round.py; they are here so bench.py, profile_round and the
+telemetry span recorder all share one fencing/warmup discipline.
 """
 
 from __future__ import annotations
+
+import time
 
 import jax
 
@@ -15,8 +20,41 @@ import jax
 # buffer layout (see bench.py's warmup note); a trace window that includes
 # them measures XLA, not the round. start_step=0 used to do exactly that —
 # now every window starts at least this many steps after the first executed
-# round.
+# round, and ``timeit`` warms with exactly this many calls (one warm call
+# used to leave the second donated-buffer layout uncompiled, so the first
+# timed rep paid a compile on donated paths).
 MIN_WARMUP_STEPS = 2
+
+
+def fence(x) -> float:
+    """Synchronize on a pytree of device values and return a scalar from
+    it. ``block_until_ready`` is unreliable through the axon TPU tunnel; a
+    scalar FETCH is the only trustworthy fence there, so both are done."""
+    import jax.numpy as jnp
+
+    leaf = jax.tree.leaves(x)[0]
+    leaf.block_until_ready()
+    return float(jnp.sum(jnp.ravel(leaf)[:1]))
+
+
+def timeit(name, fn, *args, reps: int = 10, warmup: int = MIN_WARMUP_STEPS):
+    """Mean ms/call of ``fn(*args)`` over ``reps``, printed and returned.
+
+    Warms with ``warmup`` calls (default MIN_WARMUP_STEPS=2: the first
+    compiles, the second fills the other donated-buffer layout) and fences
+    once before and once after the timed loop (steady-state pipelined
+    dispatch, the bench.py methodology)."""
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = fn(*args)
+    fence(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    fence(out)
+    dt = (time.perf_counter() - t0) / reps * 1e3
+    print(f"{name:42s} {dt:8.2f} ms")
+    return dt
 
 
 class StepProfiler:

@@ -1,0 +1,197 @@
+"""Bench regression gate: compare the latest BENCH_*.json to the trajectory.
+
+The repo carries one ``BENCH_rNN.json`` per build round (the driver wraps
+bench.py's stdout JSON line in ``{"parsed": {...}}``), but until this
+script nothing *read* the trajectory — a 20% throughput regression rode a
+green test suite straight into main. This gate compares the LATEST bench
+record against the median of the prior ones, metric by metric, with
+per-metric noise tolerances, and exits nonzero on regression:
+
+    python scripts/check_bench_regression.py            # repo-root BENCH_r*
+    python scripts/check_bench_regression.py --dir D --glob 'BENCH_r*.json'
+    python scripts/check_bench_regression.py --tolerance 0.2
+
+Comparison rules:
+
+  * Direction is per metric kind: throughput-ish metrics (``value``,
+    ``*_tokens_per_sec``, ``mfu``/``*_mfu``, ``vs_baseline``,
+    ``*_vs_uncompressed``) regress DOWN; latency-ish (``*_sec_per_round``)
+    regress UP. Everything else (strings, provenance, ``*_error``/
+    ``*_skipped`` markers, audited byte counts) is informational.
+  * Baseline = MEDIAN of the prior records carrying that metric — robust
+    to one outlier round, unlike best-ever (which ratchets noise) or
+    last-only (which lets a slow drift through one step at a time).
+  * Tolerance: relative, default 15% (the suite's wall-clock measurements
+    are load-dependent; CHANGES.md round 3 measured ~40% spread under
+    load). Per-metric overrides in ``TOLERANCES``.
+  * Apples-to-apples (the bench provenance satellite): prior records whose
+    ``chip`` differs from the latest record's are EXCLUDED from the
+    baseline — a v4 number is not a regression baseline for a v5e run.
+    Records without a ``chip`` key (pre-provenance rounds) are kept.
+  * A metric new in the latest record, or with no comparable history,
+    passes with a note. No BENCH files or only one -> pass (nothing to
+    compare).
+
+Exit codes: 0 pass, 1 regression, 2 usage error. Wired into tier-1 by
+tests/test_bench_regression.py, which includes a detects-regression
+self-test on a synthetic BENCH pair (same pattern as
+scripts/check_mode_dispatch.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from statistics import median
+
+# default relative noise tolerance; per-metric overrides below (exact
+# names, plus the MFU family via tolerance_for's suffix rule)
+DEFAULT_TOLERANCE = 0.15
+TOLERANCES = {
+    # MFU divides two measured quantities of the same run — steadier than
+    # raw throughput, so the whole family (mfu, *_mfu, *_audited_mfu)
+    # gets a tighter band
+    "mfu": 0.10,
+}
+
+LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
+HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
+HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed")
+
+
+def metric_direction(name: str):
+    """'up' (higher is better), 'down' (lower is better), or None
+    (informational — never gated)."""
+    if name.endswith(LOWER_IS_BETTER_SUFFIXES):
+        return "down"
+    if name in HIGHER_IS_BETTER_KEYS or name.endswith(
+        HIGHER_IS_BETTER_SUFFIXES
+    ):
+        return "up"
+    return None
+
+
+def load_bench(path: str) -> dict:
+    """The metric dict of one BENCH file: the driver wrapper's ``parsed``
+    block when present, else the object itself (a raw bench.py line)."""
+    with open(path) as f:
+        rec = json.load(f)
+    if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    if not isinstance(rec, dict):
+        raise ValueError(f"{path}: not a bench record")
+    return rec
+
+
+def tolerance_for(name: str, default: float) -> float:
+    if name in TOLERANCES:
+        return TOLERANCES[name]
+    if name == "mfu" or name.endswith("_mfu"):  # the whole MFU family
+        return TOLERANCES["mfu"]
+    return default
+
+
+def check_regression(history, latest, default_tolerance=DEFAULT_TOLERANCE):
+    """(regressions, notes) comparing ``latest`` (metric dict) against
+    ``history`` (list of metric dicts, oldest first). Each regression is a
+    dict naming the metric, direction, latest value, baseline and bound."""
+    regressions, notes = [], []
+    chip = latest.get("chip")
+    comparable = []
+    for h in history:
+        if chip and h.get("chip") and h["chip"] != chip:
+            notes.append(
+                f"skipping a prior record on {h['chip']!r} "
+                f"(latest ran on {chip!r})"
+            )
+            continue
+        comparable.append(h)
+    for name, v in sorted(latest.items()):
+        direction = metric_direction(name)
+        if direction is None or not isinstance(v, (int, float)) \
+                or isinstance(v, bool):
+            continue
+        prior = [
+            h[name] for h in comparable
+            if isinstance(h.get(name), (int, float))
+            and not isinstance(h.get(name), bool)
+        ]
+        if not prior:
+            notes.append(f"{name}: no comparable history (new metric?)")
+            continue
+        base = median(prior)
+        tol = tolerance_for(name, default_tolerance)
+        if direction == "up":
+            bound = base * (1.0 - tol)
+            bad = v < bound
+        else:
+            bound = base * (1.0 + tol)
+            bad = v > bound
+        if bad:
+            regressions.append({
+                "metric": name,
+                "direction": direction,
+                "latest": v,
+                "baseline_median": base,
+                "bound": bound,
+                "tolerance": tol,
+                "n_prior": len(prior),
+            })
+    return regressions, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare the latest BENCH_*.json against the trajectory"
+    )
+    ap.add_argument("--dir", default=".", help="directory holding the files")
+    ap.add_argument("--glob", default="BENCH_r*.json",
+                    help="bench-record pattern, sorted lexically "
+                    "(BENCH_r01 < BENCH_r02 < ...); the last one is the "
+                    "record under test")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="default relative noise tolerance "
+                    f"(default {DEFAULT_TOLERANCE}; per-metric overrides "
+                    "in TOLERANCES)")
+    args = ap.parse_args(argv)
+    if args.tolerance < 0:
+        print("tolerance must be >= 0")
+        return 2
+    paths = sorted(glob.glob(os.path.join(args.dir, args.glob)))
+    if len(paths) < 2:
+        print(f"nothing to compare ({len(paths)} bench record(s) match "
+              f"{args.glob!r} in {args.dir!r}) — pass")
+        return 0
+    try:
+        history = [load_bench(p) for p in paths[:-1]]
+        latest = load_bench(paths[-1])
+    except (ValueError, json.JSONDecodeError, OSError) as e:
+        print(f"unreadable bench record: {e}")
+        return 2
+    regressions, notes = check_regression(history, latest, args.tolerance)
+    for n in notes:
+        print(f"note: {n}")
+    gated = sorted(
+        k for k in latest
+        if metric_direction(k) and isinstance(latest[k], (int, float))
+    )
+    print(f"latest: {paths[-1]} vs {len(paths) - 1} prior record(s); "
+          f"{len(gated)} gated metric(s)")
+    if not regressions:
+        print("OK — no metric regressed past its tolerance")
+        return 0
+    for r in regressions:
+        arrow = "fell below" if r["direction"] == "up" else "rose above"
+        print(
+            f"REGRESSION {r['metric']}: {r['latest']:g} {arrow} "
+            f"{r['bound']:g} (median of {r['n_prior']} prior: "
+            f"{r['baseline_median']:g}, tolerance {r['tolerance']:.0%})"
+        )
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

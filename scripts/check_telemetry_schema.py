@@ -1,6 +1,6 @@
 """Validate telemetry artifacts against the versioned schema.
 
-The telemetry subsystem writes three artifact kinds per run dir
+The telemetry subsystem writes five artifact kinds per run dir
 (README "Observability" documents the full schema; the version lives in
 ``commefficient_tpu.telemetry.SCHEMA_VERSION``):
 
@@ -16,13 +16,23 @@ The telemetry subsystem writes three artifact kinds per run dir
   * ``flight_<step>.json``— divergence/crash flight record: metadata +
                             ring-buffered round records in step order
                             (+ the fedsim participation_history window)
+  * ``perf_report.json``  — compiled-round XLA audit (v3,
+                            telemetry/xla_audit.py): cost/memory analyses
+                            (nulls + reason where the backend exposes
+                            none), the HLO collective walk and its
+                            ledger cross-check. The sketch SHARDED-decode
+                            invariants are enforced HERE: every all-gather
+                            <= the W*k candidate bound and the ledger-vs-
+                            HLO byte delta within the recorded tolerance.
+  * ``spans_<step>.json`` — host phase spans (v3, telemetry/spans.py) in
+                            Chrome-trace/Perfetto event format.
 
 Consumers (plotting, run comparison, the driver's ACCURACY tooling) parse
 these blind, so the writers and this checker are pinned to each other by
-tests/test_telemetry_schema.py — the test writes artifacts through the
-REAL classes and validates them here, plus rejection cases (same pattern
-as scripts/check_mode_dispatch.py). Validators are hand-rolled: no
-jsonschema dependency in the container.
+tests/test_telemetry_schema.py + tests/test_xla_audit.py — the tests write
+artifacts through the REAL classes and validate them here, plus rejection
+cases (same pattern as scripts/check_mode_dispatch.py). Validators are
+hand-rolled: no jsonschema dependency in the container.
 
     python scripts/check_telemetry_schema.py <run_dir> [...]  # exit 1 on bad
 """
@@ -35,12 +45,15 @@ from pathlib import Path
 
 # v2 (fedsim PR): fedsim/* scalar namespace, ledger masked live-byte
 # accounting (live_client_rounds/avail_client_rounds + exactness
-# invariant), flight participation_history; v1 artifacts stay valid
-KNOWN_SCHEMA_VERSIONS = (1, 2)
+# invariant), flight participation_history; v3 (compiled-graph
+# observability PR): xla/* scalar namespace, perf_report.json,
+# spans_*.json, header/flight "artifacts" block. v1/v2 artifacts stay
+# valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
-SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/")
+SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/")
 
 
 class SchemaError(ValueError):
@@ -85,6 +98,16 @@ def _check_header(rec: dict, where: str) -> None:
     _req(rec, "start_time", str, where)
     if "config" in rec:
         _req(rec, "config", dict, where)
+    if "artifacts" in rec:
+        # v3: links to this run's profiling evidence (StepProfiler trace
+        # logdir, perf_report.json path) — string values only
+        arts = _req(rec, "artifacts", dict, where)
+        for k, v in arts.items():
+            if not isinstance(v, str):
+                raise SchemaError(
+                    f"{where}: artifacts[{k!r}] must be a path string, "
+                    f"got {type(v).__name__}"
+                )
 
 
 def _check_scalar_name(name: str, where: str,
@@ -280,6 +303,150 @@ def validate_flight(path) -> dict:
     return rec
 
 
+def _check_analysis_block(block: dict, fields, where: str) -> None:
+    """cost/memory analysis block: every field a non-negative number or
+    null; degraded blocks must say why (non-empty unavailable_reason)."""
+    for f in fields:
+        v = block.get(f)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v < 0:
+            raise SchemaError(
+                f"{where}: {f} must be a non-negative number or null, "
+                f"got {v!r}"
+            )
+    if all(block.get(f) is None for f in fields):
+        reason = block.get("unavailable_reason")
+        if not isinstance(reason, str) or not reason:
+            raise SchemaError(
+                f"{where}: fully-degraded analysis must carry a non-empty "
+                "unavailable_reason"
+            )
+
+
+def validate_perf_report(path) -> dict:
+    """Validate a perf_report.json (v3, telemetry/xla_audit.py) INCLUDING
+    the collective invariants: total_bytes == sum over ops, delta/
+    within_tolerance arithmetic consistent — and on the sketch
+    sharded-decode path, the PR-6 design claims are HARD requirements:
+    every all-gather <= the recorded W*k bound and the ledger-vs-HLO byte
+    delta within the recorded accounting tolerance."""
+    where = str(path)
+    with open(path) as f:
+        rec = _strict_loads(f.read())
+    _check_version(rec, where)
+    if rec.get("kind") != "perf_report":
+        raise SchemaError(f"{where}: kind must be 'perf_report', got "
+                          f"{rec.get('kind')!r}")
+    _req(rec, "generated_by", str, where)
+    engine = _req(rec, "engine", str, where)
+    if engine not in ("replicated", "fsdp"):
+        raise SchemaError(f"{where}: unknown engine {engine!r}")
+    _req(rec, "mode", str, where)
+    _check_header({**_req(rec, "meta", dict, where),
+                   "schema_version": rec["schema_version"]}, where + ":meta")
+    cost = _req(rec, "cost", dict, where)
+    _check_analysis_block(
+        cost, ("flops", "bytes_accessed", "transcendentals"), where + ":cost"
+    )
+    mem = _req(rec, "memory", dict, where)
+    _check_analysis_block(
+        mem, ("argument_bytes", "output_bytes", "temp_bytes", "alias_bytes",
+              "peak_hbm_bytes"), where + ":memory",
+    )
+    coll = _req(rec, "collectives", dict, where)
+    ops = _req(coll, "ops", dict, where + ":collectives")
+    total = _req(coll, "total_bytes", int, where + ":collectives")
+    op_sum = 0
+    for op, stats in ops.items():
+        w = f"{where}:collectives.ops[{op}]"
+        if op not in ("all-gather", "all-reduce", "reduce-scatter",
+                      "collective-permute"):
+            raise SchemaError(f"{w}: unknown collective op")
+        if not isinstance(stats, dict):
+            raise SchemaError(f"{w}: expected {{count, bytes}}")
+        c = _req(stats, "count", int, w)
+        b = _req(stats, "bytes", int, w)
+        if c < 1 or b < 0:
+            raise SchemaError(f"{w}: count must be >= 1 and bytes >= 0")
+        op_sum += b
+    if total != op_sum:
+        raise SchemaError(
+            f"{where}: collectives.total_bytes {total} != sum over ops "
+            f"({op_sum})"
+        )
+    # cross-check arithmetic (present iff the producer had ledger figures)
+    if coll.get("ledger_up_bytes") is not None:
+        up = _req(coll, "ledger_up_bytes", int, where + ":collectives")
+        delta = _req(coll, "delta_bytes", int, where + ":collectives")
+        tol = _req(coll, "tolerance_bytes", int, where + ":collectives")
+        within = _req(coll, "within_tolerance", bool, where + ":collectives")
+        if delta != total - up:
+            raise SchemaError(
+                f"{where}: delta_bytes {delta} != total_bytes - "
+                f"ledger_up_bytes ({total - up})"
+            )
+        if within != (abs(delta) <= tol):
+            raise SchemaError(
+                f"{where}: within_tolerance {within} inconsistent with "
+                f"|delta| {abs(delta)} vs tolerance {tol}"
+            )
+    # the sketch sharded-decode path's design claims are enforced, not
+    # merely recorded (ISSUE 7 acceptance: checker-enforced invariant)
+    if rec.get("sketch_decode") == "sharded":
+        wk = coll.get("wk_bound")
+        if not isinstance(wk, int) or wk < 1:
+            raise SchemaError(
+                f"{where}: sharded decode requires a positive wk_bound"
+            )
+        mag = coll.get("max_all_gather_elems")
+        if mag is not None and mag > wk:
+            raise SchemaError(
+                f"{where}: sharded decode all-gather of {mag} elements "
+                f"exceeds the W*k candidate bound ({wk}) — a d-sized "
+                "collective leaked into the compiled round"
+            )
+        if coll.get("within_tolerance") is False:
+            raise SchemaError(
+                f"{where}: sharded decode ledger-vs-HLO delta "
+                f"{coll.get('delta_bytes')} B outside the accounting "
+                f"tolerance {coll.get('tolerance_bytes')} B"
+            )
+    return rec
+
+
+def validate_spans(path) -> dict:
+    """Validate a spans_<step>.json (v3, telemetry/spans.py): Chrome-trace
+    complete events with step/fenced annotations."""
+    where = str(path)
+    with open(path) as f:
+        rec = _strict_loads(f.read())
+    _check_version(rec, where)
+    if rec.get("kind") != "spans":
+        raise SchemaError(
+            f"{where}: kind must be 'spans', got {rec.get('kind')!r}"
+        )
+    events = _req(rec, "traceEvents", list, where)
+    if not events:
+        raise SchemaError(f"{where}: empty traceEvents")
+    for j, ev in enumerate(events):
+        w = f"{where}:traceEvents[{j}]"
+        if not isinstance(ev, dict):
+            raise SchemaError(f"{w}: event is not an object")
+        name = _req(ev, "name", str, w)
+        if not name:
+            raise SchemaError(f"{w}: empty event name")
+        if ev.get("ph") != "X":
+            raise SchemaError(f"{w}: ph must be 'X' (complete event)")
+        for f_ in ("ts", "dur"):
+            v = _req(ev, f_, (int, float), w)
+            if v < 0:
+                raise SchemaError(f"{w}: negative {f_}")
+        args = _req(ev, "args", dict, w)
+        _req(args, "step", int, w + ":args")
+    return rec
+
+
 def validate_run_dir(run_dir) -> dict:
     """Validate every telemetry artifact found under one run dir; returns
     {artifact_path: summary}. Missing artifact kinds are fine (a level-0
@@ -298,6 +465,17 @@ def validate_run_dir(run_dir) -> dict:
         rec = validate_flight(flight)
         out[str(flight)] = (f"{len(rec['records'])} record(s), "
                             f"reason: {rec['reason'][:60]}")
+    perf = run_dir / "perf_report.json"
+    if perf.exists():
+        rec = validate_perf_report(perf)
+        coll = rec.get("collectives", {})
+        out[str(perf)] = (
+            f"{rec['engine']}/{rec['mode']}, "
+            f"{coll.get('total_bytes', 0)} collective B"
+        )
+    for spans in sorted(run_dir.glob("spans_*.json")):
+        rec = validate_spans(spans)
+        out[str(spans)] = f"{len(rec['traceEvents'])} span event(s)"
     if not out:
         raise SchemaError(f"{run_dir}: no telemetry artifacts found")
     return out

@@ -33,22 +33,12 @@ import jax.flatten_util  # noqa: F401 — binds jax.flatten_util for the stages
 import jax.numpy as jnp
 import numpy as np
 
-
-def fence(x):
-    jax.tree.leaves(x)[0].block_until_ready()
-    # scalar fetch — the only trustworthy fence through the tunnel
-    return float(jnp.sum(jax.tree.leaves(x)[0].ravel()[:1]))
-
-
-def timeit(name, fn, *args, reps=10):
-    fence(fn(*args))  # compile + warm
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-    fence(out)
-    dt = (time.perf_counter() - t0) / reps * 1e3
-    print(f"{name:42s} {dt:8.2f} ms")
-    return dt
+# shared micro-bench helpers (moved to utils.profiling so bench.py and the
+# telemetry span recorder use the same fencing/warmup discipline; timeit
+# now warms MIN_WARMUP_STEPS=2 calls — one warm call left the second
+# donated-buffer layout uncompiled, so the first timed rep paid a compile
+# on donated paths)
+from commefficient_tpu.utils.profiling import fence, timeit  # noqa: E402
 
 
 def main():
@@ -316,6 +306,23 @@ def main():
                 size=(workers, bench_batch, 32, 32, 3)).astype(np.float32)),
             "y": jnp.asarray(rng.integers(
                 0, 10, size=(workers, bench_batch)).astype(np.int32))}
+    # compiled-round audit (telemetry/xla_audit.py): the artifact's OWN
+    # FLOPs/peak-HBM/collective numbers printed next to the measured lines
+    # so the hand model and the compiler can be diffed (ISSUE 7); the
+    # audit's AOT trace doubles as the round's first compile-cache fill
+    try:
+        audit = session.audit_compiled_round(np.asarray(ids), data, 0.1)
+        print(audit.describe())
+        if audit.cost.get("flops") is not None:
+            from commefficient_tpu.telemetry.xla_audit import chip_peak_flops
+
+            peak, kind, assumed = chip_peak_flops()
+            floor_ms = audit.cost["flops"] / peak * 1e3
+            print(f"[audited] {audit.cost['flops'] / 1e9:.2f} GFLOP/round "
+                  f"-> compute-bound floor {floor_ms:.3f} ms on {kind}"
+                  + (" (peak assumed)" if assumed else ""))
+    except Exception as e:  # noqa: BLE001 — the audit must not kill the lab
+        print(f"[audited] compiled-round audit unavailable: {e}")
     round_fn = session.round_fn
     n = 10
 

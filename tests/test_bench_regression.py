@@ -1,0 +1,116 @@
+"""Bench regression gate (scripts/check_bench_regression.py) self-tests:
+a within-tolerance trajectory passes, a real regression is DETECTED (the
+vacuous-pass guard, same pattern as scripts/check_mode_dispatch.py), and
+the provenance/direction rules hold — all on synthetic BENCH pairs."""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _gate():
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_regression",
+        os.path.join(REPO, "scripts", "check_bench_regression.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, name, parsed):
+    # the driver's wrapper format ({"parsed": {...}} around the bench line)
+    (tmp_path / name).write_text(json.dumps({"parsed": parsed}))
+
+
+BASELINE = {
+    "metric": "fed_resnet9_sketch_train_samples_per_sec_per_chip",
+    "value": 32000.0, "unit": "samples/s", "vs_baseline": 1.6,
+    "mfu": 0.375, "chip": "TPU v5 lite",
+    "gpt2_sketch_tokens_per_sec": 32000.0,
+    "gpt2_sketch_sec_per_round": 0.50,
+    "gpt2_sketch_vs_uncompressed": 0.29,
+}
+
+
+def test_within_tolerance_passes(tmp_path):
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    _write(tmp_path, "BENCH_r02.json",
+           {**BASELINE, "value": 31000.0, "mfu": 0.36,
+            "gpt2_sketch_sec_per_round": 0.52})
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_detects_throughput_regression(tmp_path):
+    """The detects-regression self-test: a 40% headline drop must exit
+    nonzero and name the metric."""
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    _write(tmp_path, "BENCH_r02.json", {**BASELINE, "value": 19000.0})
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _ = mod.check_regression([BASELINE],
+                                   {**BASELINE, "value": 19000.0})
+    assert [r["metric"] for r in regs] == ["value"]
+    assert regs[0]["direction"] == "up"
+
+
+def test_detects_latency_regression(tmp_path):
+    """*_sec_per_round is lower-is-better: a rise past tolerance gates."""
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    _write(tmp_path, "BENCH_r02.json",
+           {**BASELINE, "gpt2_sketch_sec_per_round": 0.80})
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_median_baseline_is_outlier_robust(tmp_path):
+    """One freak-fast prior round must not turn a normal round into a
+    'regression' — the baseline is the MEDIAN of the trajectory."""
+    mod = _gate()
+    hist = [BASELINE, {**BASELINE, "value": 64000.0}, BASELINE]
+    regs, _ = mod.check_regression(hist, dict(BASELINE))
+    assert regs == []
+
+
+def test_cross_chip_records_are_excluded(tmp_path):
+    """Provenance satellite: a prior record from different hardware is not
+    a baseline (apples-to-apples across hosts)."""
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json",
+           {**BASELINE, "chip": "TPU v4", "value": 90000.0})
+    _write(tmp_path, "BENCH_r02.json", BASELINE)
+    # the v4 90k number would gate the v5e 32k run without the exclusion
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    regs, notes = mod.check_regression(
+        [{**BASELINE, "chip": "TPU v4", "value": 90000.0}], dict(BASELINE)
+    )
+    assert regs == []
+    assert any("TPU v4" in n for n in notes)
+
+
+def test_single_record_and_informational_keys_pass(tmp_path):
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    assert mod.main(["--dir", str(tmp_path)]) == 0  # nothing to compare
+    # error/skip markers and audited byte counts never gate
+    for key in ("gpt2_sketch_error", "gpt2_skipped",
+                "audited_collective_bytes", "audited_peak_hbm_bytes",
+                "chip", "jax"):
+        assert mod.metric_direction(key) is None
+    assert mod.metric_direction("gpt2_sketch_pallas_tokens_per_sec") == "up"
+    assert mod.metric_direction("audited_mfu") == "up"
+    # the tighter MFU band covers the whole family, not just the bare key
+    for name in ("mfu", "gpt2_sketch_mfu", "gpt2_sketch_audited_mfu"):
+        assert mod.tolerance_for(name, mod.DEFAULT_TOLERANCE) == 0.10
+
+
+def test_raw_bench_line_format_accepted(tmp_path):
+    """Files holding the bare bench.py JSON line (no driver wrapper) are
+    accepted too."""
+    mod = _gate()
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(BASELINE))
+    _write(tmp_path, "BENCH_r02.json", BASELINE)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
