@@ -323,6 +323,63 @@ def _measure(cfg, n_rounds: int = 20, audit_box: dict = None) -> float:
     return sps
 
 
+def _measure_ladder_switch(base_cfg, n_rounds: int = 8) -> dict:
+    """Cost of a mid-run compression-ladder rung switch (control/ PR) on
+    the headline sketch round: a 2-rung k-ladder under a fixed schedule
+    that switches halfway. Reports the steady samples/s, the wall-clock of
+    the FIRST round after the switch (state migration + the prewarmed
+    rung's first dispatch — its XLA backend-compile, but never a
+    re-trace), and the sentinel's retrace count, which must be 0 — the
+    whole point of AOT rung prewarming."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.control import build_controller
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.profiling import fence
+
+    half = n_rounds // 2
+    cfg = base_cfg.replace(
+        control_policy="fixed",
+        control_schedule=f"0-{half - 1}=0,{half}-=1",
+        ladder=f"k={base_cfg.k},{max(base_cfg.k // 2, 1)}",
+    )
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply, compute_dtype=cfg.compute_dtype)
+    session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+    ctrl = build_controller(cfg, session, num_rounds=n_rounds + 3)
+
+    rng = np.random.default_rng(0)
+    W, B = cfg.num_workers, cfg.local_batch_size
+    ids = rng.choice(cfg.num_clients, size=W, replace=False).astype(np.int32)
+    batch = {
+        "x": rng.normal(size=(W, B, 32, 32, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(W, B)).astype(np.int32),
+    }
+    session.prewarm_rungs(ids, batch, 0.1)
+    # warm rung 0 (compile + donated-layout second compile) OUTSIDE the
+    # schedule by driving the session's round clock through rounds 0..2 of
+    # a schedule that holds rung 0 until the switch
+    times = []
+    for r in range(3 + n_rounds):
+        t0 = time.perf_counter()
+        m = session.train_round(ids, batch, 0.1)
+        assert np.isfinite(fence(m["loss"]))
+        times.append(time.perf_counter() - t0)
+    # the switch fires at round index `half` (clock r == half)
+    switch_ms = times[half] * 1e3
+    steady = times[3:half] + times[half + 1:]
+    sps = W * B / (sum(steady) / len(steady))
+    return {
+        "sketch_ladder_steady": round(sps, 2),
+        "sketch_ladder_switch_round_ms": round(switch_ms, 1),
+        "sketch_ladder_retraces": session.retrace_sentinel.retraces,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -400,6 +457,21 @@ def main():
             rows[name] = round(sps, 2)
             print(json.dumps({"metric": name, "value": rows[name],
                               "unit": "samples/s"}))
+        # control PR: the rung-switch cost on the headline sketch round —
+        # 2-rung k-ladder, fixed schedule switching halfway. The retrace
+        # count is the design claim (0: the switch dispatches a prewarmed
+        # program); switch_round_ms is its one-off backend-compile +
+        # state-migration cost; steady sps tracks the (expected-zero)
+        # controller host tax vs the headline.
+        try:
+            ctl = _measure_ladder_switch(base)
+        except Exception as e:  # noqa: BLE001
+            rows["sketch_ladder_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "sketch_ladder_switch",
+                              "error": rows["sketch_ladder_error"]}))
+        else:
+            rows.update(ctl)
+            print(json.dumps({"metric": "sketch_ladder_switch", **ctl}))
 
     audit_box: dict = {}
     headline = _measure(_headline_cfg(), audit_box=audit_box)

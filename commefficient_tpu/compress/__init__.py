@@ -45,6 +45,10 @@ Protocol (see ``base.Compressor`` for the full signatures):
   * ``device_encode(vec)``       — linear encode, once per device, pre-psum
   * ``server_update(...)``       — momentum/error algebra + extract, post-psum
   * ``fsdp_update(...)``         — the sharded-state server path (optional)
+  * ``migrate_state(...)``       — carry state across a control/ ladder-rung
+                                   switch (sketch re-sketches tables across
+                                   column geometries; powersgd pads/truncates
+                                   its warm Q; dense banks pass through)
   * ``upload_floats()/download_floats()`` — bytes_per_round accounting
 
 Error-feedback semantics are the FetchSGD Algorithm-1 contract pinned by

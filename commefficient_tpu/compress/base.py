@@ -302,6 +302,25 @@ class Compressor:
         nothing to report."""
         return {}
 
+    # ---- rung migration (control/ compression ladder) --------------------
+    def migrate_state(self, new: "Compressor", momentum, error, extra):
+        """Carry compressor-managed FedState leaves across a ladder-rung
+        switch: ``self`` is the OLD rung's compressor, ``new`` the one the
+        next round dispatches (same mode, different rung parameters —
+        control/ladder.py restricts rungs to ``k``/``num_cols``/
+        ``powersgd_rank``). Returns ``(momentum, error, extra)`` shaped
+        for ``new``. Runs eagerly on the host round boundary (switches are
+        rare; nothing here is traced into the round).
+
+        Base implementation: identity — for every dense-state mode a
+        ``k`` change alters only the EXTRACTION sparsity, and the [D]
+        momentum/error banks (and absent () leaves) are
+        rung-parameter-independent, so the switch is free. Modes whose
+        state layout depends on a ladder field override (sketch re-sketches
+        its tables across column geometries; powersgd pads/truncates its
+        warm Q across ranks)."""
+        return momentum, error, extra
+
     # ---- communication accounting (bytes_per_round) ----------------------
     def upload_floats(self) -> int:
         """Per-client uplink floats per round."""

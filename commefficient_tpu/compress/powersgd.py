@@ -197,6 +197,28 @@ class PowerSGDCompressor(Compressor):
         den = jnp.sqrt(jnp.sum(jnp.square(compressed_input)))
         return {"powersgd_recon_rel_err": num / jnp.maximum(den, 1e-30)}
 
+    # ---- rung migration (control/ compression ladder) --------------------
+    def migrate_state(self, new, momentum, error, extra):
+        """Rank-rung migration: the dense [D] momentum/error banks are
+        rank-independent (pass through), and the warm-start Q [m, r]
+        migrates by column surgery — rank DOWN truncates to the first
+        r_new columns (the power iteration re-orthonormalizes P each
+        round, so the retained columns keep tracking the top subspace),
+        rank UP pads with this compressor's seed-derived fresh Gaussian
+        columns (the paper's init for directions not yet tracked; one
+        round of iteration absorbs them). Without warm start there is no
+        carried state on either side — () passes through."""
+        if not self.cfg.powersgd_warm_start or isinstance(extra, tuple):
+            return momentum, error, extra
+        r_old, r_new = self.rank, new.rank
+        if r_new == r_old:
+            return momentum, error, extra
+        if r_new < r_old:
+            return momentum, error, extra[:, :r_new]
+        fresh = new.init_extra_state()  # [m, r_new] seed-derived Gaussian
+        q = jnp.concatenate([extra, fresh[:, r_old:]], axis=1)
+        return momentum, error, q
+
     def download_floats(self) -> int:
         # the applied delta is exactly representable as (P_hat, Q_new)
         return self.rank * (self.n + self.m)

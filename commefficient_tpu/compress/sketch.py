@@ -277,6 +277,37 @@ class SketchCompressor(Compressor):
         den = jnp.sqrt(jnp.sum(jnp.square(val)))
         return {"sketch_est_rel_err": num / jnp.maximum(den, 1e-30)}
 
+    # ---- rung migration (control/ compression ladder) --------------------
+    def migrate_state(self, new, momentum, error, extra):
+        """Sketch-mode rung migration. ``k``-only switches are FREE: the
+        tables are a function of the spec geometry, not of k (k only
+        selects how many heavy hitters the unsketch extracts), so identical
+        specs pass through untouched. A ``num_cols`` switch changes the
+        table layout, and a table sketched under one layout is
+        meaningless under another — so each [r, c_old] bank is decoded to
+        its top-k heavy-hitter support and RE-SKETCHED into the new
+        layout: ``new_table = S_new(U_old(table, k))``. By linearity of
+        both maps this carries exactly the decodable signal mass; the
+        sub-threshold residual the old table still held is dropped (the
+        same kind of controlled leak as ``error_decay``), which is the
+        honest trade — there is no lossless map between CountSketch
+        geometries. The decode uses this rung's top-k kernel at
+        ``cfg.k`` (the old rung's own extraction semantics)."""
+        if new.spec is not None and self.spec is not None and (
+                new.spec.table_shape == self.spec.table_shape
+                and new.spec.c == self.spec.c
+                and new.spec.num_blocks == self.spec.num_blocks):
+            return momentum, error, extra
+
+        def move(table):
+            if isinstance(table, tuple):
+                return table
+            dense = self.unsketch(self.spec, table, self.cfg.k)
+            idx, val = compact_nonzero(dense, self.cfg.k)
+            return sketch_sparse(new.spec, idx, val)
+
+        return move(momentum), move(error), extra
+
     def upload_floats(self) -> int:
         """The REALIZED table size ``r * c_actual`` (the blocked layout
         rounds the requested num_cols to bucket-block multiples), not the

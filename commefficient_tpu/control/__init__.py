@@ -1,0 +1,83 @@
+"""Adaptive communication-budget control plane.
+
+FetchSGD (arXiv:2007.07682) fixes its compression operating point (k,
+sketch columns, powersgd rank) once per run, but the EF analysis it leans
+on (arXiv:1903.04488; sharpened by arXiv:2305.15264) says the USEFUL
+compression level varies over training — early rounds tolerate aggressive
+compression, late rounds pay for it in error-feedback residual growth.
+This repo already measures those signals (``diag/ef_residual_norm``,
+level-2 fidelity, fedsim participation, the audited per-round bytes);
+this package closes the loop:
+
+  * ``ladder``     — an ordered rung set, each rung a validated
+                     compression-parameter delta over the base Config
+                     (``--ladder "k=60000,30000,10000"``). Every rung's
+                     round program is resolved at session build and
+                     AOT-prewarmed for the run's real round signature, so
+                     a rung switch is a dispatch-table lookup — NEVER a
+                     silent mid-run retrace (per-rung RetraceSentinel
+                     signature streams pin it).
+  * ``policy``     — pluggable host-side rung selection: ``fixed``
+                     (round-range schedule), ``budget_pacing`` (spend
+                     ``--budget_mb`` evenly over the remaining rounds,
+                     hard-stopping with ``BudgetExhaustedError`` when even
+                     the cheapest rung would overshoot), ``ef_feedback``
+                     (closed loop on EF-residual slope + fidelity, with
+                     hysteresis).
+  * ``controller`` — the loop itself: reads drained telemetry, picks next
+                     round's rung, migrates compressor-private state
+                     across rungs (``Compressor.migrate_state``), emits
+                     ``control/*`` scalars, accounts bytes with exactly
+                     the CommLedger's arithmetic, and checkpoints its
+                     state so resume reproduces the rung sequence
+                     bit-exactly.
+
+``control_policy='none'`` (default) builds NOTHING: the session is
+single-rung, no controller exists, and the compiled round is bit-identical
+to a pre-control build (golden parity recordings pin it) — the same
+python-level gate discipline as ``telemetry_level 0`` and
+``availability='always'``.
+
+Layering: host-side logic over compress/-provided accounting hooks;
+``parallel/api.py`` and the train entries import this package,
+``utils/config.py`` imports ``ladder``/``policy`` lazily for flag
+validation (the fedsim no-cycle pattern). Policy-string dispatch lives in
+``policy.py`` (and config validation) ONLY — enforced by
+scripts/check_mode_dispatch.py.
+"""
+
+from commefficient_tpu.control.controller import (
+    BudgetController,
+    build_controller,
+    controller_header,
+)
+from commefficient_tpu.control.ladder import (
+    LADDER_FIELDS,
+    ladder_configs,
+    parse_ladder,
+    validate_rung_costs,
+)
+from commefficient_tpu.control.policy import (
+    CONTROL_POLICIES,
+    BudgetExhaustedError,
+    ControlPolicy,
+    get_policy,
+    initial_rung_index,
+    parse_schedule,
+)
+
+__all__ = [
+    "BudgetController",
+    "BudgetExhaustedError",
+    "CONTROL_POLICIES",
+    "ControlPolicy",
+    "LADDER_FIELDS",
+    "build_controller",
+    "controller_header",
+    "get_policy",
+    "initial_rung_index",
+    "ladder_configs",
+    "parse_ladder",
+    "parse_schedule",
+    "validate_rung_costs",
+]

@@ -1,0 +1,143 @@
+"""Compression ladder — the ordered rung set a controller switches between.
+
+Ladder grammar (the ``--ladder`` flag, same validate-at-construction
+discipline as fedsim's chaos strings):
+
+    field=v1,v2,...[;field=w1,w2,...]
+
+  * ``field`` is one of the rung-tunable compression parameters
+    (``LADDER_FIELDS``): ``k``, ``num_cols``, ``powersgd_rank``. Every
+    other Config field is shared by all rungs.
+  * Each field lists ONE value per rung; multiple fields (``;``-separated)
+    must list the same number of values — rung i takes the i-th value of
+    every listed field.
+  * Rungs must be ordered most-expensive first: rung 0 is the highest-
+    fidelity/highest-byte setting and each later rung is strictly cheaper
+    (validated against the realized ``bytes_per_round`` at session build,
+    where the compressor geometry is known — e.g. the sketch table's
+    realized ``r * c_actual``).
+
+Example: ``--ladder "k=60000,30000,10000"`` is a three-rung ladder that
+only varies the extraction sparsity;
+``--ladder "k=50000,25000;num_cols=500000,250000"`` shrinks the sketch
+table along with k.
+
+Each rung resolves to a full ``Config`` via ``base.replace(**overrides)``
+at parse time, so an invalid rung (e.g. ``powersgd_rank=0``) fails with
+the Config's own validation error, named per rung, before anything is
+built. Layering: this module is host-side and duck-types the config (same
+no-cycle pattern as fedsim — ``utils.config`` imports it lazily for flag
+validation).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+# Config fields a rung may override. Everything here changes only the
+# compression OPERATING POINT (payload size / extraction sparsity), never
+# the federation shape or the optimization semantics — that is what makes
+# a mid-run switch meaningful rather than a different experiment.
+LADDER_FIELDS = ("k", "num_cols", "powersgd_rank")
+
+_GRAMMAR = (
+    '";"-separated "field=v1,v2,..." lists with field in '
+    f"{LADDER_FIELDS} and one value per rung (all fields the same "
+    'length), e.g. "k=60000,30000,10000" or '
+    '"k=50000,25000;num_cols=500000,250000"'
+)
+
+
+def _fail(spec: str, why: str) -> ValueError:
+    return ValueError(f"bad ladder {spec!r}: {why}. Grammar: {_GRAMMAR}")
+
+
+def parse_ladder(spec: str) -> Tuple[dict, ...]:
+    """Parse a ladder string into one override dict per rung; '' -> ().
+    Raises ValueError (with the grammar) on any syntax problem."""
+    if not spec or not spec.strip():
+        return ()
+    fields = {}
+    for raw in spec.split(";"):
+        part = raw.strip()
+        if "=" not in part:
+            raise _fail(spec, f"segment {part!r} lacks '=values'")
+        name, _, vals_s = part.partition("=")
+        name = name.strip()
+        if name not in LADDER_FIELDS:
+            raise _fail(spec, f"unknown ladder field {name!r}")
+        if name in fields:
+            raise _fail(spec, f"field {name!r} listed twice")
+        vals = []
+        for v in vals_s.split(","):
+            v = v.strip()
+            try:
+                vals.append(int(v))
+            except ValueError:
+                raise _fail(
+                    spec, f"{name}={v!r} is not an integer"
+                ) from None
+        if not vals:
+            raise _fail(spec, f"field {name!r} lists no values")
+        if any(v < 1 for v in vals):
+            raise _fail(spec, f"{name} values must be >= 1, got {vals}")
+        fields[name] = vals
+    lengths = {len(v) for v in fields.values()}
+    if len(lengths) != 1:
+        raise _fail(
+            spec,
+            "every field must list one value per rung — got lengths "
+            + ", ".join(f"{k}:{len(v)}" for k, v in sorted(fields.items())),
+        )
+    n = lengths.pop()
+    return tuple(
+        {name: vals[i] for name, vals in fields.items()} for i in range(n)
+    )
+
+
+def ladder_configs(cfg) -> tuple:
+    """The per-rung Config tuple for ``cfg``: one ``cfg.replace(**rung)``
+    per parsed rung, or ``(cfg,)`` when the ladder is empty (a controller
+    over a single implicit rung — pure budget enforcement). Each rung's
+    replace re-runs Config validation, so an override combination the base
+    config would reject (e.g. a sketch envelope violation stays a warning,
+    but ``powersgd_rank=0`` is an error) fails HERE with the rung named."""
+    rungs = parse_ladder(cfg.ladder)
+    if not rungs:
+        return (cfg,)
+    out = []
+    for i, ov in enumerate(rungs):
+        try:
+            out.append(cfg.replace(**ov))
+        except ValueError as e:
+            raise ValueError(
+                f"ladder rung {i} ({ov}) produces an invalid config: {e}"
+            ) from e
+    return tuple(out)
+
+
+def validate_rung_costs(bytes_per_rung) -> None:
+    """Enforce the ladder's cost ordering: per-round total bytes
+    NON-INCREASING with rung index (rung 0 = most expensive / highest
+    fidelity). Policies lean on this — ``ef_feedback`` steps index-1 to
+    SPEND more and index+1 to SAVE, and ``budget_pacing`` scans from 0
+    for the most expensive affordable rung. Ties are legal: a sketch
+    ``k`` ladder moves the extraction fidelity without touching the
+    table's link bytes (FetchSGD accounting: the uplink IS the table) —
+    byte-identical rungs still order by fidelity for the ef loop, they
+    are just indistinguishable to pacing. ``bytes_per_rung`` is a
+    sequence of bytes_per_round dicts in rung order (the session computes
+    them from each rung's realized compressor geometry)."""
+    totals = [
+        int(b["upload_bytes"]) + int(b["download_bytes"])
+        for b in bytes_per_rung
+    ]
+    for i in range(1, len(totals)):
+        if totals[i] > totals[i - 1]:
+            raise ValueError(
+                f"ladder rung {i} costs {totals[i]:,} B/round, MORE than "
+                f"rung {i - 1} ({totals[i - 1]:,} B/round) — order rungs "
+                "most-expensive first (the realized cost can differ from "
+                "the request, e.g. the sketch table's blocked layout; "
+                f"per-rung totals: {totals})"
+            )

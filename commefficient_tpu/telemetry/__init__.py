@@ -35,6 +35,13 @@ system FROM THE COMPILED ARTIFACT instead of trusting analytic models:
     env / device_put / round dispatch / drain / checkpoint) dumped as
     ``spans_<step>.json`` next to the StepProfiler's XLA traces.
 
+Since the adaptive-communication PR this package is also the control
+plane's sensory path: the ``control/`` subsystem's ``ef_feedback`` policy
+consumes the drained ``diag/*`` scalars, its per-round ``control/*``
+scalars ride the same metric dicts, the CommLedger bills each drained
+round at the rung its ``control/rung`` scalar names (schema v4 per-rung
+invariant), and flight dumps carry the dump-time controller snapshot.
+
 Telemetry levels (``--telemetry_level``):
 
   0 — off (default). Zero traced ops, zero host work; bit-identical rounds.
@@ -86,7 +93,13 @@ from commefficient_tpu.telemetry.xla_audit import (
 # checker-enforced sharded-decode collective invariant, spans_*.json
 # Chrome-trace phase spans, and the header/flight "artifacts" block
 # linking a run to its StepProfiler logdir + perf report.
-SCHEMA_VERSION = 3
+# v4 (adaptive communication-budget PR): the control/* scalar namespace
+# (active rung, switch count, budget remainder), the ledger's per-rung
+# accounting block ("rungs": rounds + bytes_per_round per ladder rung,
+# whose cum-bytes invariant is the sum over rungs of active-rung bytes —
+# live-count-weighted under fedsim masking), and the header/flight
+# "controller" block (policy, ladder, rung at write/dump time).
+SCHEMA_VERSION = 4
 
 TELEMETRY_LEVELS = (0, 1, 2)
 
@@ -118,13 +131,22 @@ def build_telemetry_riders(cfg, session, writer):
     ``bytes_per_round()``, ``grad_size``, ``mesh``)."""
     if getattr(cfg, "telemetry_level", 0) < 1 or writer is None:
         return None, None
+    # control/ ladder runs switch the ledger to per-rung accounting: each
+    # drained round is billed at the rung its control/rung scalar names
+    # (schema v4); single-rung sessions keep the flat invariant
+    rungs = None
+    session_rungs = getattr(session, "rungs", None)
+    if session_rungs is not None and len(session_rungs) > 1:
+        rungs = [(session.rung_bytes_per_round(i), r.compressor)
+                 for i, r in enumerate(session_rungs)]
     # fedsim runs switch the ledger to masked live-byte accounting: only
     # live clients' uplink counts, through the compressor's mask-aware
     # accounting hook (compress/base.masked_upload_floats)
     ledger = CommLedger(session.bytes_per_round(), mode=cfg.mode,
                         num_workers=cfg.num_workers,
                         masked=bool(getattr(cfg, "fedsim_enabled", False)),
-                        compressor=getattr(session, "compressor", None))
+                        compressor=getattr(session, "compressor", None),
+                        rungs=rungs)
     flight = FlightRecorder(
         cfg, logdir=writer.logdir,
         extra_meta={"grad_size": session.grad_size,
@@ -134,6 +156,9 @@ def build_telemetry_riders(cfg, session, writer):
                     # divergence post-mortem starts from the flight record
                     # and must be able to find the trace + perf report
                     "artifacts": run_artifacts(cfg, writer.logdir)},
+        # dump-time controller attribution (schema v4) — the controller is
+        # attached to the session by build_controller before the riders
+        controller=getattr(session, "controller", None),
     )
     return ledger, flight
 

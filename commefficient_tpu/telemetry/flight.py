@@ -90,7 +90,7 @@ class FlightRecorder:
     """
 
     def __init__(self, cfg=None, logdir: str = "", window: Optional[int] = None,
-                 extra_meta: Optional[dict] = None):
+                 extra_meta: Optional[dict] = None, controller=None):
         from commefficient_tpu.telemetry.ledger import run_metadata
 
         self.logdir = logdir
@@ -101,6 +101,12 @@ class FlightRecorder:
         self.meta = run_metadata(cfg, extra_meta)
         self.records: deque = deque(maxlen=self.window)
         self.last_step: Optional[int] = None
+        # duck-typed adaptive-communication controller (control/): when
+        # set, every dump carries its snapshot() AT DUMP TIME (active
+        # rung, switch count, budget state) so a divergence is
+        # attributable to a rung switch — the per-record control/rung
+        # scalars then give the switch history inside the window
+        self.controller = controller
 
     def record(self, step: int, lr: float, scalars: dict) -> None:
         self.last_step = int(step)
@@ -166,6 +172,15 @@ class FlightRecorder:
         ]
         if hist:
             payload["participation_history"] = hist
+        if self.controller is not None:
+            # controller attribution (schema v4): "did a rung switch
+            # precede the blow-up?" is the budgeted-run post-mortem's
+            # first question — the dump-time controller state rides
+            # top-level, next to the per-record control/rung trajectory
+            try:
+                payload["controller"] = self.controller.snapshot()
+            except Exception:  # noqa: BLE001 — a dump must never fail
+                pass
         with open(path, "w") as f:
             json.dump(
                 jsonable_tree(payload),

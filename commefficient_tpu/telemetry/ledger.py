@@ -84,12 +84,28 @@ class CommLedger:
     """
 
     def __init__(self, bytes_per_round: Dict[str, int], *, mode: str,
-                 num_workers: int, masked: bool = False, compressor=None):
+                 num_workers: int, masked: bool = False, compressor=None,
+                 rungs=None):
         self.bytes_per_round = {k: int(v) for k, v in bytes_per_round.items()}
         self.mode = mode
         self.num_workers = int(num_workers)
         self.masked = bool(masked)
         self._comp = compressor  # duck-typed: masked_upload_floats(live)
+        # control/ ladder accounting (schema v4): ``rungs`` is the ordered
+        # [(bytes_per_round dict, compressor), ...] of the session's
+        # compression ladder; each drained round is billed at the rung its
+        # ``control/rung`` scalar names (riding the same metric dict, the
+        # fedsim-recovery pattern), and the exactness invariant becomes the
+        # SUM over rungs of that rung's rounds x its bytes_per_round
+        # (live-count-weighted under masking) — checker-enforced.
+        self.rungs = None
+        if rungs is not None:
+            self.rungs = [
+                {"bytes_per_round": {k: int(v) for k, v in bpr.items()},
+                 "compressor": comp, "rounds": 0,
+                 "live_client_rounds": 0, "avail_client_rounds": 0}
+                for bpr, comp in rungs
+            ]
         self.rounds = 0
         self.cum_up_bytes = 0
         self.cum_down_bytes = 0
@@ -113,16 +129,35 @@ class CommLedger:
                  scalars: Optional[Dict[str, float]] = None) -> Dict[str, float]:
         """Account one drained round; returns this step's comm/* scalars.
         ``scalars`` is the round's drained metric dict (the fedsim/*
-        participation scalars live there); ignored unless ``masked``."""
-        up = self.bytes_per_round["upload_bytes"]
-        down = self.bytes_per_round["download_bytes"]
+        participation scalars live there, and — for ladder runs — the
+        ``control/rung`` scalar naming which rung this round ran at)."""
+        rung_rec = None
+        bpr, comp = self.bytes_per_round, self._comp
+        if self.rungs is not None:
+            # the round's active rung from its own drained scalar — the
+            # ledger can never disagree with what the run logged
+            r = int(round(float((scalars or {}).get("control/rung", 0.0))))
+            if not 0 <= r < len(self.rungs):
+                raise ValueError(
+                    f"drained round {step} names rung {r}, but the ledger "
+                    f"was built for {len(self.rungs)} rung(s)"
+                )
+            rung_rec = self.rungs[r]
+            bpr, comp = rung_rec["bytes_per_round"], rung_rec["compressor"]
+        up = bpr["upload_bytes"]
+        down = bpr["download_bytes"]
         if self.masked:
             live, avail = self._counts(scalars)
-            up = (4 * self._comp.masked_upload_floats(live)
-                  if self._comp is not None else live * up)
+            up = (4 * comp.masked_upload_floats(live)
+                  if comp is not None else live * up)
             down = avail * down
             self.live_client_rounds += live
             self.avail_client_rounds += avail
+            if rung_rec is not None:
+                rung_rec["live_client_rounds"] += live
+                rung_rec["avail_client_rounds"] += avail
+        if rung_rec is not None:
+            rung_rec["rounds"] += 1
         self.rounds += 1
         self.cum_up_bytes += up
         self.cum_down_bytes += down
@@ -153,6 +188,17 @@ class CommLedger:
             #   cum_down_bytes == avail_client_rounds * download_bytes
             out["live_client_rounds"] = self.live_client_rounds
             out["avail_client_rounds"] = self.avail_client_rounds
+        if self.rungs is not None:
+            # control/ ladder accounting (schema v4): per-rung rounds +
+            # byte rates; the checker-enforced invariant becomes
+            #   cum_up_bytes == sum_r rounds_r * up_r            (full)
+            #   cum_up_bytes == sum_r live_r * up_r              (masked)
+            # and likewise for the downlink — exact ints, no tolerance.
+            out["rungs"] = [
+                {k: v for k, v in r.items() if k != "compressor"
+                 and (self.masked or not k.endswith("_client_rounds"))}
+                for r in self.rungs
+            ]
         return out
 
     def write(self, logdir: str) -> str:

@@ -182,6 +182,7 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     table = table or TableLogger()
     timer = Timer()
     from commefficient_tpu.telemetry import (
+        DivergenceError,
         build_perf_observability,
         build_telemetry_riders,
         record_crash,
@@ -189,6 +190,21 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
+    # adaptive-communication controller (control/): None unless the config
+    # turns the control plane on. Built BEFORE the telemetry riders (the
+    # ledger switches to per-rung accounting, the flight recorder carries
+    # the controller snapshot) and BEFORE any restore (a resumed rung
+    # sequence needs the controller attached); prewarm AOT-traces every
+    # rung's round program for the run's real round-0 signature, so a
+    # mid-run rung switch can never be a silent retrace.
+    from commefficient_tpu.control import build_controller
+
+    controller = build_controller(
+        cfg, session, num_rounds=steps_per_epoch * cfg.num_epochs
+    )
+    if controller is not None:
+        controller.prewarm(sampler, float(lr_fn(0)))
+        print(controller.describe())
     # telemetry riders (level >= 1): the comm ledger sources the SAME
     # bytes_per_round accounting the session prints at startup; the flight
     # recorder dumps flight_<step>.json + raises DivergenceError on a
@@ -203,6 +219,11 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     )
     val = {}
     step = 0
+    # the current epoch's drain closure, reachable from the crash handler:
+    # a BudgetExhaustedError (or any mid-epoch crash) fires BEFORE the
+    # deferred epoch-end drain, so without this flush the ledger/flight
+    # would be blind to the crashed epoch's completed rounds
+    live_drain = [None]
     if checkpointer is not None and cfg.resume:
         restored = checkpointer.restore(session)
         if restored is not None:
@@ -227,11 +248,14 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if spans is not None:
                     with spans.span("metric_drain"):
                         drain_round_metrics(pending, writer, acc,
-                                            ledger=ledger, flight=flight)
+                                            ledger=ledger, flight=flight,
+                                            controller=controller)
                 else:
                     drain_round_metrics(pending, writer, acc,
-                                        ledger=ledger, flight=flight)
+                                        ledger=ledger, flight=flight,
+                                        controller=controller)
 
+            live_drain[0] = drain
             use_idx = getattr(session, "_dev_data", None) is not None
             rounds = (
                 prefetch(sampler.epoch_indices(epoch))
@@ -290,6 +314,17 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 writer.scalar("val/acc", val.get("accuracy", 0.0), step)
                 writer.flush()
     except Exception as e:
+        # best-effort flush of the crashed epoch's completed rounds so the
+        # ledger totals and the flight ring cover them (a flush-time
+        # DivergenceError supersedes: it names the true first bad round)
+        if live_drain[0] is not None and not isinstance(
+                e, DivergenceError):
+            try:
+                live_drain[0]()
+            except DivergenceError:
+                raise
+            except Exception:  # noqa: BLE001 — the original error wins
+                pass
         # divergence already dumped its own flight record in the drain;
         # any OTHER crash dumps the recent trajectory for the post-mortem
         record_crash(flight, e)
@@ -329,7 +364,10 @@ def main(argv=None, **overrides):
     bpr = session.bytes_per_round()
     print(f"grad_size D={session.grad_size}  upload/client/round="
           f"{bpr['upload_bytes']:,} B  download={bpr['download_bytes']:,} B")
-    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg)
+    from commefficient_tpu.control import controller_header
+
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg,
+                           extra_header=controller_header(session))
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 
     checkpointer = FedCheckpointer(cfg)

@@ -149,6 +149,7 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     table = table or TableLogger()
     timer = Timer()
     from commefficient_tpu.telemetry import (
+        DivergenceError,
         build_perf_observability,
         build_telemetry_riders,
         record_crash,
@@ -156,6 +157,19 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
+    # adaptive-communication controller (control/), same wiring as
+    # cv_train: built before the riders (per-rung ledger accounting,
+    # flight snapshot) and before any restore; prewarm AOT-traces every
+    # rung so a mid-run switch can never be a silent retrace — at GPT-2
+    # scale that is ONE extra trace per rung, not an extra XLA compile.
+    from commefficient_tpu.control import build_controller
+
+    controller = build_controller(
+        cfg, session, num_rounds=steps_per_epoch * cfg.num_epochs
+    )
+    if controller is not None:
+        controller.prewarm(sampler, float(lr_fn(0)))
+        print(controller.describe())
     # telemetry riders (level >= 1), shared constructor with cv_train
     ledger, flight = build_telemetry_riders(cfg, session, writer)
     # perf observability (level >= 1), shared constructor with cv_train:
@@ -169,6 +183,9 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
     val = {}
     step = 0
     W = cfg.num_workers
+    # crash-reachable drain closure — see cv_train.train_loop (a mid-epoch
+    # BudgetExhaustedError/crash fires before the deferred drain)
+    live_drain = [None]
     if checkpointer is not None and cfg.resume:
         restored = checkpointer.restore(session)
         if restored is not None:
@@ -194,11 +211,14 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 if spans is not None:
                     with spans.span("metric_drain"):
                         drain_round_metrics(pending, writer, acc,
-                                            ledger=ledger, flight=flight)
+                                            ledger=ledger, flight=flight,
+                                            controller=controller)
                 else:
                     drain_round_metrics(pending, writer, acc,
-                                        ledger=ledger, flight=flight)
+                                        ledger=ledger, flight=flight,
+                                        controller=controller)
 
+            live_drain[0] = drain
             use_idx = getattr(session, "_dev_data", None) is not None
             rounds = (
                 prefetch(sampler.epoch_indices(epoch))
@@ -270,6 +290,16 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                 print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
                       f"-> {gen.tolist()}")
     except Exception as e:
+        # best-effort flush of the crashed epoch's completed rounds (see
+        # cv_train.train_loop; a flush-time DivergenceError supersedes)
+        if live_drain[0] is not None and not isinstance(
+                e, DivergenceError):
+            try:
+                live_drain[0]()
+            except DivergenceError:
+                raise
+            except Exception:  # noqa: BLE001 — the original error wins
+                pass
         record_crash(flight, e)
         raise
     finally:
@@ -415,7 +445,10 @@ def main(argv=None, **overrides):
     )
     # token arrays live in HBM when they fit; rounds ship only [W, B] indices
     session.maybe_attach_data(train, sampler)
-    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg)
+    from commefficient_tpu.control import controller_header
+
+    writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg,
+                           extra_header=controller_header(session))
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 
     # full-state checkpoints go under <checkpoint_dir>/state; the HF-format

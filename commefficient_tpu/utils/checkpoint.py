@@ -55,6 +55,13 @@ def _to_saveable(session) -> dict:
         out["host_vel"] = session.host_vel
     if session.host_err is not None:
         out["host_err"] = session.host_err
+    if getattr(session, "controller", None) is not None:
+        # adaptive-communication controller state (control/): active rung,
+        # switch count, byte spend, policy slots — restoring it is what
+        # makes a resumed run reproduce the rung sequence bit-exactly
+        # (drains happen before saves, so the blob reflects every drained
+        # round <= this checkpoint's step)
+        out["control"] = session.controller.state_blob()
     return out
 
 
@@ -126,44 +133,113 @@ class FedCheckpointer:
         except Exception:  # noqa: BLE001 — probe is best-effort
             return "sketch_layout" in str(exc)
 
+    @staticmethod
+    def _rung_template_candidates(session) -> list:
+        """Rung indices whose state template is worth attempting a restore
+        under: the active rung first, then ONE representative of every
+        other distinct (momentum, error, comp) shape signature. A k-only
+        ladder has a single signature (rung switches don't change state
+        shapes), so restore never retries; a num_cols/rank ladder retries
+        once per distinct geometry until the template matches the rung the
+        checkpoint was saved at (the controller blob then names it
+        exactly). ``[None]`` for control-less sessions."""
+        rungs = getattr(session, "rungs", None)
+        if rungs is None or len(rungs) <= 1:
+            return [None]
+
+        def sig(i):
+            st = session._rung_state_struct(rungs[i])
+            return tuple(
+                tuple(getattr(st, f).shape)
+                if hasattr(getattr(st, f), "shape") else ()
+                for f in ("momentum", "error", "comp")
+            )
+
+        out = [session.active_rung]
+        seen = {sig(session.active_rung)}
+        for i in range(len(rungs)):
+            s = sig(i)
+            if s not in seen:
+                seen.add(s)
+                out.append(i)
+        return out
+
+    def _attempt_restore(self, step: int, template: dict):
+        """One StandardRestore attempt, absorbing the known
+        template/saved key differences: pre-PR2 checkpoints lack the
+        ``comp`` FedState leaf; pre-control checkpoints lack the
+        ``control`` blob — each retried with the key dropped (the session
+        keeps its fresh leaf/state). The mismatch is detected from the
+        exception because ``item_metadata`` returns None on a freshly
+        opened manager — no handler registry yet — so a pre-restore
+        structure probe is not available."""
+        import orbax.checkpoint as ocp
+
+        template = {**template, "fed_state": dict(template["fed_state"])}
+        for _ in range(3):  # at most: full, -control, -comp
+            try:
+                return self.mngr.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+            except ValueError as e:
+                msg = str(e)
+                if "Dict key mismatch" not in msg:
+                    raise
+                if "control" in template and "control" in msg:
+                    # pre-control checkpoint into a controlled session:
+                    # restore the rest; the controller starts at its
+                    # initial rung (warned below, once restore succeeds)
+                    template.pop("control")
+                    continue
+                if "comp" in template["fed_state"] and "comp" in msg:
+                    # pre-PR2 checkpoint: retry with the 6-leaf template
+                    template["fed_state"].pop("comp")
+                    continue
+                if "control" in msg and "control" not in template:
+                    raise ValueError(
+                        "checkpoint carries adaptive-control state "
+                        "('control' blob) but this session was built "
+                        "without a controller — restore with the same "
+                        "control_policy/ladder the run was saved under "
+                        f"(underlying: {e})"
+                    ) from e
+                raise
+        raise ValueError("restore retries exhausted")  # unreachable
+
     def restore(self, session, step: Optional[int] = None) -> Optional[int]:
         """Restore into ``session`` in place; returns the restored round
         index (== FedState.step) or None if nothing to restore.
 
-        Checkpoints written before the compress/ registry (PR 2) lack the
-        ``comp`` FedState leaf and StandardRestore raises 'Dict key
-        mismatch' on any template/saved key difference; restore then
-        retries with the pre-PR2 template and keeps the session's freshly
-        initialized leaf (legacy modes: ()), so old checkpoints stay
-        restorable. (The mismatch is detected from the exception because
-        ``item_metadata`` returns None on a freshly opened manager — no
-        handler registry yet — so a pre-restore structure probe is not
-        available.)"""
+        Controlled sessions (control/ ladder): the checkpointed server
+        state is laid out for the rung ACTIVE at save time, which a
+        shape-changing ladder (num_cols/powersgd_rank) may make differ
+        from the session's current template — restore walks the distinct
+        rung layouts until one matches, then the restored ``control``
+        blob re-activates the exact saved rung and policy state, so the
+        resumed run reproduces the uninterrupted rung sequence."""
         if not self.enabled:
             return None
         step = step if step is not None else self.mngr.latest_step()
         if step is None:
             return None
-        import orbax.checkpoint as ocp
 
-        template = _to_saveable(session)
+        candidates = self._rung_template_candidates(session)
         try:
-            try:
-                restored = self.mngr.restore(
-                    step, args=ocp.args.StandardRestore(template)
-                )
-            except ValueError as e:
-                if not (
-                    "comp" in template["fed_state"]
-                    and "Dict key mismatch" in str(e)
-                    and "comp" in str(e)
-                ):
-                    raise
-                # pre-PR2 checkpoint: retry with the 6-leaf template
-                template["fed_state"].pop("comp")
-                restored = self.mngr.restore(
-                    step, args=ocp.args.StandardRestore(template)
-                )
+            restored = None
+            for n, cand in enumerate(candidates):
+                if cand is not None and cand != session.active_rung:
+                    # rebuild the template in rung ``cand``'s layout; the
+                    # migrated VALUES are irrelevant (overwritten on
+                    # success) — only the shapes matter here
+                    session.set_active_rung(cand, migrate=True)
+                try:
+                    restored = self._attempt_restore(
+                        step, _to_saveable(session)
+                    )
+                    break
+                except Exception:  # noqa: BLE001 — try the next layout
+                    if n == len(candidates) - 1:
+                        raise
         except Exception as e:  # noqa: BLE001 — re-raise with provenance
             if session.spec is not None and self._saved_lacks_sketch_layout(
                 step, e
@@ -185,6 +261,16 @@ class FedCheckpointer:
                     f"problem, the underlying failure was: {e})"
                 ) from e
             raise
+        if (getattr(session, "controller", None) is not None
+                and "control" in restored):
+            # activate the SAVED rung before the layout/shape checks below:
+            # the restored leaves (and the sketch-layout stamp) are in that
+            # rung's geometry, not necessarily the session's current one.
+            # (Dispatch swap only — the leaves themselves load further
+            # down; the controller's counters load after them.)
+            saved_rung = int(np.asarray(restored["control"])[1])
+            if 0 <= saved_rung < len(session.rungs):
+                session.set_active_rung(saved_rung, migrate=False)
         if session.spec is not None and "sketch_layout" in restored:
             want = _spec_fingerprint(session.spec)
             got = np.asarray(restored["sketch_layout"])
@@ -247,6 +333,24 @@ class FedCheckpointer:
             session.host_vel = np.asarray(restored["host_vel"])
         if "host_err" in restored:
             session.host_err = np.asarray(restored["host_err"])
+        if getattr(session, "controller", None) is not None:
+            if "control" in restored:
+                # re-activates the saved rung (the restored leaves are
+                # already in its layout — dispatch swap only, no
+                # migration) + the policy's decision state, so the
+                # resumed rung sequence is bit-identical to the
+                # uninterrupted run's
+                session.controller.load_state_blob(restored["control"])
+            else:
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint at step {step} predates the adaptive-"
+                    "communication controller; restored everything else — "
+                    "the controller starts fresh (initial rung, zero byte "
+                    "spend), so the resumed rung sequence is NOT the "
+                    "uninterrupted run's"
+                )
         # the fedsim availability/chaos schedule keys off a host round
         # clock mirroring FedState.step — re-sync it so a resumed run
         # realizes the SAME masks the uninterrupted run would have

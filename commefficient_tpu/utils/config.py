@@ -23,6 +23,9 @@ ERROR_TYPES = ("none", "local", "virtual")
 # mirrors the fedsim/ availability registry (fedsim.available_models);
 # pinned equal by tests/test_fedsim.py — same no-cycle pattern as MODES
 AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "sine")
+# mirrors the control/ policy registry (control.CONTROL_POLICIES); pinned
+# equal by tests/test_control.py — same no-cycle pattern as MODES
+CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
 
 
 @dataclass(frozen=True)
@@ -306,6 +309,52 @@ class Config:
     # time (Config cannot know steps_per_epoch).
     chaos: str = ""
 
+    # --- adaptive communication budget (commefficient_tpu/control/;
+    # TPU-native — the reference fixes k/num_cols/rank once per run) ---
+    # Rung-selection policy: "none" (default — NOTHING control-related is
+    # built and the round stays bit-identical to a pre-control build, the
+    # telemetry_level-0 discipline), "fixed" (round-range schedule via
+    # control_schedule), "budget_pacing" (spend budget_mb evenly over the
+    # remaining rounds, dropping to cheaper rungs as the ledger's cum
+    # bytes approach the cap; hard BudgetExhaustedError when even the
+    # cheapest rung would overshoot), "ef_feedback" (closed loop on the
+    # diag/ef_residual_norm slope + level-2 fidelity, with hysteresis).
+    control_policy: str = "none"
+    # Compression ladder (control/ladder.py grammar): ";"-separated
+    # "field=v1,v2,..." lists over k / num_cols / powersgd_rank, one value
+    # per rung, ordered most-expensive first — e.g.
+    # "k=60000,30000,10000". Every rung's round program is AOT-prewarmed
+    # at run start, so a switch is a dispatch-table lookup, never a
+    # mid-run retrace. Empty with budget_pacing = a single implicit rung
+    # (pure budget cap enforcement, no switching).
+    ladder: str = ""
+    # Total communication budget in MB (decimal, 10^6 B) over the run's
+    # cumulative ledger bytes (up + down, live-byte units under fedsim
+    # masking — the same units comm/cum_bytes logs). 0 = no budget.
+    # Enforced by the controller for ANY policy; required > 0 for
+    # budget_pacing.
+    budget_mb: float = 0.0
+    # fixed-policy schedule: comma-separated "A-B=rung" round ranges
+    # (B empty = open-ended), e.g. "0-99=2,100-=0". Rounds outside every
+    # range run rung 0.
+    control_schedule: str = ""
+    # ef_feedback thresholds on the per-round RELATIVE slope of
+    # diag/ef_residual_norm: slope > control_ef_up -> climb one rung
+    # toward more bytes; slope < control_ef_down -> step one rung cheaper;
+    # in between -> hold. up > down required (the dead band is half the
+    # anti-oscillation story; the hysteresis window is the other half).
+    control_ef_up: float = 0.15
+    control_ef_down: float = 0.0
+    # Worst level-2 fidelity (any diag/*_rel_err: sketch round-trip error,
+    # powersgd reconstruction residual) above which ef_feedback climbs
+    # regardless of the EF slope; 0 disables the fidelity trigger (it
+    # needs telemetry_level >= 2 to have data).
+    control_fidelity_max: float = 0.0
+    # Minimum rounds between ef_feedback switches (hysteresis): within the
+    # window the policy holds whatever the signals say, so the loop cannot
+    # oscillate every round (tests/test_control.py pins the property).
+    control_hysteresis: int = 8
+
     # --- misc (reference: --seed; the mesh-shape flags above are ours) ---
     seed: int = 42
     checkpoint_dir: str = ""
@@ -480,6 +529,120 @@ class Config:
                 f"beyond the first compile) or None (count only), got "
                 f"{self.max_retraces}"
             )
+        self._validate_control()
+
+    def _validate_control(self) -> None:
+        """Adaptive-communication-budget flags (control/). Grammar/shape
+        validation happens here at construction; byte-cost ordering of the
+        rungs needs the realized compressor geometry and is validated at
+        session build, and schedule ranges vs the run length at
+        train-entry time (the chaos-rounds pattern)."""
+        if self.control_policy not in CONTROL_POLICIES:
+            raise ValueError(
+                f"control_policy must be one of {CONTROL_POLICIES}, got "
+                f"{self.control_policy!r}"
+            )
+        # lazy imports keep the no-cycle layering (control never imports
+        # config at runtime — the fedsim.faults pattern)
+        rungs = ()
+        if self.ladder:
+            from commefficient_tpu.control.ladder import (
+                LADDER_FIELDS,
+                parse_ladder,
+            )
+
+            rungs = parse_ladder(self.ladder)  # syntax ValueError w/ grammar
+            if self.control_policy == "none":
+                raise ValueError(
+                    "a ladder without a controller would silently never "
+                    "switch — set control_policy (fixed | budget_pacing | "
+                    "ef_feedback), or drop --ladder"
+                )
+            if self.mode != "powersgd" and any(
+                    "powersgd_rank" in r for r in rungs):
+                raise ValueError(
+                    f"ladder field powersgd_rank has no effect with "
+                    f"mode={self.mode!r} — the rung switch would be a "
+                    "silent no-op; ladder fields must act on the active "
+                    f"mode ({LADDER_FIELDS} minus the inert ones)"
+                )
+            if self.mode != "sketch" and any("num_cols" in r for r in rungs):
+                raise ValueError(
+                    f"ladder field num_cols has no effect with "
+                    f"mode={self.mode!r} (no sketch table) — the rung "
+                    "switch would be a silent no-op"
+                )
+            if (self.mode in ("uncompressed", "fedavg")
+                    and not self.do_topk_down
+                    and any("k" in r for r in rungs)):
+                # (with do_topk_down, k sizes the downlink top-k — a k
+                # ladder is then a real downlink-budget ladder)
+                raise ValueError(
+                    f"ladder field k has no effect with mode={self.mode!r} "
+                    "(dense transmit, no top-k extraction) — the rung "
+                    "switch would be a silent no-op"
+                )
+        if self.control_policy == "ef_feedback":
+            if len(rungs) < 2:
+                raise ValueError(
+                    "control_policy='ef_feedback' needs a ladder with >= 2 "
+                    "rungs to move between — pass --ladder (e.g. "
+                    '"k=60000,30000,10000")'
+                )
+            if self.telemetry_level < 1:
+                raise ValueError(
+                    "control_policy='ef_feedback' consumes the drained "
+                    "diag/ef_residual_norm telemetry — set "
+                    "--telemetry_level >= 1 (>= 2 if control_fidelity_max "
+                    "is used)"
+                )
+            if not self.control_ef_up > self.control_ef_down:
+                raise ValueError(
+                    f"control_ef_up ({self.control_ef_up}) must exceed "
+                    f"control_ef_down ({self.control_ef_down}): the dead "
+                    "band between them is what stops threshold flapping"
+                )
+        if self.control_policy == "fixed":
+            from commefficient_tpu.control.policy import parse_schedule
+
+            sched = parse_schedule(self.control_schedule)
+            if not sched:
+                raise ValueError(
+                    "control_policy='fixed' needs --control_schedule "
+                    '(e.g. "0-99=2,100-=0")'
+                )
+            n_rungs = max(len(rungs), 1)
+            for start, end, rung in sched:
+                if rung >= n_rungs:
+                    raise ValueError(
+                        f"control_schedule names rung {rung}, but the "
+                        f"ladder has {n_rungs} rung(s) (indices 0.."
+                        f"{n_rungs - 1})"
+                    )
+        elif self.control_schedule:
+            raise ValueError(
+                "control_schedule only drives control_policy='fixed'; "
+                f"with {self.control_policy!r} it would be silently ignored"
+            )
+        if self.budget_mb < 0:
+            raise ValueError(f"budget_mb must be >= 0, got {self.budget_mb}")
+        if self.control_policy == "budget_pacing" and not self.budget_mb > 0:
+            raise ValueError(
+                "control_policy='budget_pacing' paces against --budget_mb; "
+                "set it > 0"
+            )
+        if self.budget_mb > 0 and self.control_policy == "none":
+            raise ValueError(
+                "budget_mb is enforced by the control plane; with "
+                "control_policy='none' nothing would watch it — use "
+                "control_policy='budget_pacing' (a ladder is optional: "
+                "without one the budget is a pure hard cap)"
+            )
+        if self.control_hysteresis < 1:
+            raise ValueError(
+                f"control_hysteresis must be >= 1 round, got "
+                f"{self.control_hysteresis}"
+            )
 
     @property
     def clients_per_device(self) -> int:
@@ -492,6 +655,14 @@ class Config:
         keeps the round trace IDENTICAL to a fedsim-less build — the
         golden parity recordings pin that (fedsim/ package docstring)."""
         return self.availability != "always" or bool(self.chaos)
+
+    @property
+    def control_enabled(self) -> bool:
+        """True when the adaptive-communication control plane must be
+        built (multi-rung session + controller). False keeps the session
+        single-rung and bit-identical to a pre-control build — the golden
+        parity recordings pin that (control/ package docstring)."""
+        return self.control_policy != "none"
 
     @property
     def sampler_batch_size(self) -> int:

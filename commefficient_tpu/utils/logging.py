@@ -94,11 +94,11 @@ class MetricsWriter:
     """
 
     def __init__(self, logdir: str, enable_tensorboard: bool = False,
-                 cfg=None):
+                 cfg=None, extra_header=None):
         self.logdir = logdir
         os.makedirs(logdir, exist_ok=True)
         self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a")
-        self._write_header(cfg)
+        self._write_header(cfg, extra_header)
         self._tb = None
         if enable_tensorboard:
             try:
@@ -108,7 +108,7 @@ class MetricsWriter:
             except Exception:
                 self._tb = None
 
-    def _write_header(self, cfg) -> None:
+    def _write_header(self, cfg, extra_header=None) -> None:
         # lazy import: telemetry owns the versioned schema + the shared
         # run_metadata block (flight records embed the same one); the
         # config snapshot is sanitized like every other artifact so a
@@ -129,6 +129,11 @@ class MetricsWriter:
             arts = run_artifacts(cfg, self.logdir)
             if arts:
                 rec["artifacts"] = arts
+        if extra_header:
+            # v4: run-identifying blocks a caller supplies beyond the
+            # config snapshot — e.g. the adaptive-communication controller
+            # block (policy, ladder, initial rung: control.controller_header)
+            rec.update(extra_header)
         self._jsonl.write(json.dumps(jsonable_tree(rec),
                                      allow_nan=False) + "\n")
         self._jsonl.flush()
@@ -209,7 +214,7 @@ def pack_metric_dicts(dicts):
 
 
 def drain_round_metrics(pending, writer, accumulate, ledger=None,
-                        flight=None) -> None:
+                        flight=None, controller=None) -> None:
     """Fetch buffered per-round DEVICE metrics and clear the buffer.
 
     Train loops append ``(step, lr, metrics)`` without fetching (a float()
@@ -231,6 +236,10 @@ def drain_round_metrics(pending, writer, accumulate, ledger=None,
         ``DivergenceError`` naming the first bad round. The buffer is
         cleared and the writer flushed even on that raise, so the bad
         rounds' scalars survive for the post-mortem.
+      ``controller`` — a control.BudgetController (duck-typed
+        ``observe_drained(step, scalars)``); each drained round's scalars
+        feed the rung-selection policy in step order (the ``ef_feedback``
+        loop's telemetry input).
     """
     if not pending:
         return
@@ -253,6 +262,8 @@ def drain_round_metrics(pending, writer, accumulate, ledger=None,
                 for k, v in comm.items():
                     writer.scalar(k, v, s)
             accumulate(loss, metrics)
+            if controller is not None:
+                controller.observe_drained(s, metrics)
             if flight is not None:
                 flight.record(s, s_lr, {
                     **{k: float(metrics[k]) for k in names}, **comm,

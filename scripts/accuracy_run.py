@@ -46,6 +46,19 @@ def main():
                          "bit-identical round")
     ap.add_argument("--logdir", default="runs",
                     help="root for the per-run metrics/ledger/flight dirs")
+    ap.add_argument("--budget_mb", type=float, default=None,
+                    help="hard communication budget (decimal MB of "
+                         "cumulative ledger bytes, up + down) applied to "
+                         "EVERY run via the control plane "
+                         "(control_policy=budget_pacing, no ladder — a "
+                         "pure cap): runs that exhaust it stop with "
+                         "BudgetExhaustedError and are recorded as honest "
+                         "truncated rows (accuracy of the model at the "
+                         "stop round), so loss-vs-bytes curves can be "
+                         "read at a FIXED byte budget. NB budgeted rows "
+                         "change the x-axis semantics — every run ends at "
+                         "<= the same cum bytes instead of the same "
+                         "round count (see ACCURACY.md).")
     ap.add_argument("--dropout", type=float, default=None,
                     help="fedsim bernoulli per-client dropout probability "
                          "applied to EVERY run: masked clients transmit "
@@ -61,6 +74,7 @@ def main():
                          "classic per-client table.")
     args = ap.parse_args()
 
+    from commefficient_tpu.control import BudgetExhaustedError
     from commefficient_tpu.telemetry import DivergenceError
     from commefficient_tpu.train.cv_train import (
         build_model_and_data,
@@ -90,15 +104,21 @@ def main():
         # ledger uses the same fleet live-byte units as the lossy runs —
         # that is what makes the 0%-vs-30% loss-vs-bytes comparison valid.
         base.update(availability="bernoulli", dropout_prob=args.dropout)
+    if args.budget_mb is not None:
+        # the control plane enforces the cap (controller accounting ==
+        # ledger accounting exactly); no ladder -> a single implicit rung,
+        # so this is the pure fixed-byte-budget x-axis, not adaptation
+        base.update(control_policy="budget_pacing",
+                    budget_mb=args.budget_mb)
     k = 50_000
-    # Per-mode (lr_scale, pivot_epoch), tuned by scripts/r3_sweep.py — the
+    # Per-mode (lr_scale, pivot_epoch), tuned by scripts/archive/r3_sweep.py — the
     # FetchSGD paper tunes lr per compression config the same way (§5).
     # Momentum modes need ~(1-rho)x the SGD lr: with server momentum the
     # effective step is lr/(1-rho), so rho=0.9 at the SGD-tuned 0.4 was
     # training at effective lr 4.0 and stalling (the r3 pre-sweep table).
     piv = max(2, args.num_epochs // 4)
     # r4: schedules re-tuned on the v3 concentrated task by
-    # scripts/r4_retune.py (runs/r4_retune.log) — every grid single-peaked;
+    # scripts/archive/r4_retune.py (runs/r4_retune.log) — every grid single-peaked;
     # the v2-task optima transferred almost everywhere (sketch_rho0 and
     # local_topk moved to 0.8; true_topk runs the unmasked-momentum corner
     # whose tuned lr is 0.04 — see the four-corner ablation).
@@ -172,7 +192,10 @@ def main():
             cfg, train, params, loss_fn, augment
         )
         bpr = session.bytes_per_round()
-        writer = MetricsWriter(make_logdir(cfg), cfg=cfg)
+        from commefficient_tpu.control import controller_header
+
+        writer = MetricsWriter(make_logdir(cfg), cfg=cfg,
+                               extra_header=controller_header(session))
         t0 = time.time()
         try:
             val = train_loop(cfg, session, sampler, test, writer)
@@ -181,13 +204,24 @@ def main():
             # record has the forensics; the table gets an honest NaN row
             print(f"== {name}: DIVERGED — {e}", flush=True)
             val = {"loss": float("nan")}
+        except BudgetExhaustedError as e:
+            # the budget stopped the run BEFORE the unaffordable round:
+            # the params are finite and every spent byte is within the
+            # cap, so the honest truncated row is the model's accuracy AT
+            # the stop round (the fixed-budget loss-vs-bytes point),
+            # clearly labelled — mirroring the DivergenceError handling
+            print(f"== {name}: BUDGET EXHAUSTED — {e}", flush=True)
+            val = session.evaluate(test.eval_batches(512))
+            name = f"{name} (budget-truncated @ round {e.step})"
         finally:
             writer.close()
         dt = time.time() - t0
+        acc = val.get("accuracy", float("nan"))
         rows.append((name, cfg.lr_scale, cfg.pivot_epoch, cfg.dropout_prob,
+                     cfg.budget_mb,
                      bpr["upload_bytes"], bpr["download_bytes"],
-                     val.get("accuracy", float("nan")), val["loss"], dt))
-        print(f"== {name}: acc={rows[-1][6]:.4f} upload={bpr['upload_bytes']:,}B "
+                     acc, val["loss"], dt))
+        print(f"== {name}: acc={acc:.4f} upload={bpr['upload_bytes']:,}B "
               f"({dt:.0f}s)", flush=True)
         _write(args, base, k, rows, real, pre_rows)  # incremental
 
@@ -202,14 +236,14 @@ def _write(args, base, k, rows, real, pre_rows=()):
         "",
         f"Data: {label}. {base['num_epochs']} epochs, 8 workers/round, "
         f"local batch {base['local_batch_size']}, piecewise-linear lr "
-        "TUNED PER MODE by scripts/r4_retune.py (the FetchSGD paper tunes "
+        "TUNED PER MODE by scripts/archive/r4_retune.py (the FetchSGD paper tunes "
         "lr per compression config, §5; momentum modes need ~(1-rho)x the "
         f"SGD lr — see accuracy_run.py). k={k}; sketch rows name their "
         "r x c split (identical table bytes). Produced by "
         "`python scripts/accuracy_run.py` on one TPU v5e chip.",
         "",
-        "| mode | lr (peak) | pivot ep | dropout | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "| mode | lr (peak) | pivot ep | dropout | budget MB | upload B/client/round | download B/round | final val acc | final val loss | train time (s) |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
     ncols = lines[-2].count("|")
     for r in pre_rows:
@@ -223,16 +257,25 @@ def _write(args, base, k, rows, real, pre_rows=()):
                 f"written — rerun without --skip): {r}"
             )
     lines.extend(pre_rows)
-    for name, lr, pv, drop, up, down, acc, loss, dt in rows:
+    for name, lr, pv, drop, budget, up, down, acc, loss, dt in rows:
+        budget_cell = f"{budget:g}" if budget else "—"
         lines.append(
-            f"| {name} | {lr} | {pv} | {drop:g} | {up:,} | {down:,} | "
-            f"{acc:.4f} | {loss:.4f} | {dt:.0f} |"
+            f"| {name} | {lr} | {pv} | {drop:g} | {budget_cell} | {up:,} | "
+            f"{down:,} | {acc:.4f} | {loss:.4f} | {dt:.0f} |"
         )
     lines += [
         "",
         "The FetchSGD north star (BASELINE.md) is sketch matching the",
         "uncompressed baseline's accuracy at reduced upload bytes/round —",
         "compare the sketch rows against row 1 at the byte counts shown.",
+        "",
+        "Budgeted rows (`--budget_mb`, the control/ hard cap) CHANGE the",
+        "loss-vs-bytes x-axis semantics: unbudgeted rows all end at the",
+        "same ROUND count (cum bytes differ per mode), budgeted rows all",
+        "end at <= the same CUM BYTES (round counts differ — cheap modes",
+        "run the full schedule, expensive ones stop early as",
+        "budget-truncated rows). Compare budgeted rows only against",
+        "budgeted rows.",
     ]
     # Preserve any hand-written analysis section in the existing file: the
     # table is regenerated, the narrative (e.g. "## Reading these numbers

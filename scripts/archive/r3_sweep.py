@@ -8,7 +8,7 @@ the uncompressed baseline's 0.4. This sweeps (lr_scale, pivot_epoch) for
 the flagship sketch config to find the stable schedule; the FetchSGD paper
 tunes lr per compression config the same way (§5).
 
-    python scripts/r3_sweep.py [--mode sketch] [--epochs 24]
+    python scripts/archive/r3_sweep.py [--mode sketch] [--epochs 24]
 """
 
 from __future__ import annotations
@@ -18,7 +18,8 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
 
 def main():

@@ -9,9 +9,9 @@
 Appends result sections to ACCURACY.md (below the CIFAR table) and logs to
 runs/r4_baseline_evidence.log.
 
-    python scripts/r4_baseline_evidence.py femnist
-    python scripts/r4_baseline_evidence.py imagenet
-    python scripts/r4_baseline_evidence.py all
+    python scripts/archive/r4_baseline_evidence.py femnist
+    python scripts/archive/r4_baseline_evidence.py imagenet
+    python scripts/archive/r4_baseline_evidence.py all
 """
 
 from __future__ import annotations
@@ -22,9 +22,10 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
-ROOT = Path(__file__).resolve().parent.parent
+ROOT = Path(__file__).resolve().parents[2]
 LOG = ROOT / "runs" / "r4_baseline_evidence.log"
 
 

@@ -6,8 +6,8 @@ recorded only final val acc, so underfit-vs-overfit was never separated).
 Each run prints a cifar10-fast-style table; results append to
 runs/r4_dense_lab.log.
 
-    python scripts/r4_dense_lab.py ceiling_diag      # run a named suite
-    python scripts/r4_dense_lab.py one uncompressed --lr 0.8 --epochs 48
+    python scripts/archive/r4_dense_lab.py ceiling_diag      # run a named suite
+    python scripts/archive/r4_dense_lab.py one uncompressed --lr 0.8 --epochs 48
 """
 
 from __future__ import annotations
@@ -17,9 +17,10 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_dense_lab.log"
+LOG = Path(__file__).resolve().parents[2] / "runs" / "r4_dense_lab.log"
 
 
 def run_one(name: str, *, variant: str = "concentrated", epochs: int = 24,

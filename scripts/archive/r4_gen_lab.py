@@ -10,8 +10,8 @@ lr is capped by those directions (divergence at lr>=1.2), starving the
 class-signal directions — a conditioning pathology that per-coordinate
 error-feedback methods (local_topk) sidestep.
 
-    python scripts/r4_gen_lab.py probe     # mechanism probes (bg ablation)
-    python scripts/r4_gen_lab.py one --bg_scale 10 --bg_rank 48 --lr 0.8
+    python scripts/archive/r4_gen_lab.py probe     # mechanism probes (bg ablation)
+    python scripts/archive/r4_gen_lab.py one --bg_scale 10 --bg_rank 48 --lr 0.8
 """
 
 from __future__ import annotations
@@ -21,9 +21,10 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_gen_lab.log"
+LOG = Path(__file__).resolve().parents[2] / "runs" / "r4_gen_lab.log"
 
 
 def run_one(name: str, gen_kw: dict, *, mode="uncompressed", lr=0.8,

@@ -1,7 +1,8 @@
 import sys
 from pathlib import Path
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
-from scripts.r4_gpt2_twin import run_one
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[1]))
+from r4_gpt2_twin import run_one  # sibling in scripts/archive/
 # d/c=40 + error_decay 0.9 at GPT-2 scale: 5 x 3.11M table (~8x upload
 # compression), the envelope-extension claim run for real.
 from commefficient_tpu.train import gpt2_train

@@ -8,8 +8,8 @@ tuned PER MODE over a small grid, token-weighted val nll after every epoch
 table (d/c~=25, ~5x upload compression — the reference's own GPT-2 run
 compresses ~3.9x uplink, FetchSGD §5).
 
-    python scripts/r4_gpt2_twin.py sweep       # the lr grids, both modes
-    python scripts/r4_gpt2_twin.py one --mode sketch --lr 0.08
+    python scripts/archive/r4_gpt2_twin.py sweep       # the lr grids, both modes
+    python scripts/archive/r4_gpt2_twin.py one --mode sketch --lr 0.08
 """
 
 from __future__ import annotations
@@ -20,9 +20,10 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_gpt2_twin.log"
+LOG = Path(__file__).resolve().parents[2] / "runs" / "r4_gpt2_twin.log"
 
 
 def run_one(mode: str, lr: float, *, epochs=6, pivot=2, seq=256, batch=4,

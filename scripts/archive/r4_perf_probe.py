@@ -1,6 +1,7 @@
 import sys
 from pathlib import Path
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 import time, numpy as np, jax, jax.numpy as jnp
 from commefficient_tpu.ops.countsketch import CountSketch, sketch_vec, estimate_all
 

@@ -2,8 +2,8 @@
 methodology (the paper tunes lr per compression config, FetchSGD §5).
 Feeds the tuned schedules into scripts/accuracy_run.py's `sched` table.
 
-    python scripts/r4_retune.py all          # every mode's grid
-    python scripts/r4_retune.py sketch7      # one group
+    python scripts/archive/r4_retune.py all          # every mode's grid
+    python scripts/archive/r4_retune.py sketch7      # one group
 """
 
 from __future__ import annotations
@@ -13,9 +13,10 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 
-LOG = Path(__file__).resolve().parent.parent / "runs" / "r4_retune.log"
+LOG = Path(__file__).resolve().parents[2] / "runs" / "r4_retune.log"
 
 K = 50_000
 

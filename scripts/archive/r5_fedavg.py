@@ -24,9 +24,9 @@ sets local_batch_size=16 to hold 64 samples/client/round across the triad:
 fedavg "wins" if it beats iso-bytes (same uploads, more local work) while
 approaching iso-steps (same optimization work, 4x the uploads).
 
-    python scripts/r5_fedavg.py grid                 # tuned triad, CIFAR v3
-    python scripts/r5_fedavg.py imagenet             # tuned ImageNet redo
-    python scripts/r5_fedavg.py one --config fedavg --lr 0.4
+    python scripts/archive/r5_fedavg.py grid                 # tuned triad, CIFAR v3
+    python scripts/archive/r5_fedavg.py imagenet             # tuned ImageNet redo
+    python scripts/archive/r5_fedavg.py one --config fedavg --lr 0.4
 """
 
 from __future__ import annotations
@@ -36,7 +36,8 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from labutil import ROOT, log_json

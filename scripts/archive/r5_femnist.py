@@ -11,8 +11,8 @@ to 1.0000. This redo applies the repo's own methodology:
   * the final table quotes each mode at ITS OWN tuned lr, with the full
     grids appended for audit.
 
-    python scripts/r5_femnist.py grid            # both modes, doubling grid
-    python scripts/r5_femnist.py one --mode local_topk --lr 0.4
+    python scripts/archive/r5_femnist.py grid            # both modes, doubling grid
+    python scripts/archive/r5_femnist.py one --mode local_topk --lr 0.4
 """
 
 from __future__ import annotations
@@ -22,7 +22,8 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from labutil import ROOT, log_json

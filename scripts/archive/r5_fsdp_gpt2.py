@@ -16,8 +16,8 @@ Three measurements at D = 124M (GPT-2-small), none of which existed before:
   8-device CPU mesh (slow on one core; proves the full path runs at scale,
   not just at test size).
 
-    python scripts/r5_fsdp_gpt2.py account
-    python scripts/r5_fsdp_gpt2.py chip
+    python scripts/archive/r5_fsdp_gpt2.py account
+    python scripts/archive/r5_fsdp_gpt2.py chip
 """
 
 from __future__ import annotations
@@ -27,7 +27,8 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from labutil import ROOT, log_json

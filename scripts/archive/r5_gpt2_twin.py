@@ -13,9 +13,9 @@ Two defects in the r4 twin evidence, and the runs that close them:
 Reuses r4_gpt2_twin.run_one (same model/config/protocol) but logs to
 runs/r5_gpt2_twin.log so rounds stay separable.
 
-    python scripts/r5_gpt2_twin.py extend
-    python scripts/r5_gpt2_twin.py deep
-    python scripts/r5_gpt2_twin.py one --mode sketch --lr 0.32 --epochs 24
+    python scripts/archive/r5_gpt2_twin.py extend
+    python scripts/archive/r5_gpt2_twin.py deep
+    python scripts/archive/r5_gpt2_twin.py one --mode sketch --lr 0.32 --epochs 24
 """
 
 from __future__ import annotations
@@ -24,7 +24,8 @@ import argparse
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import r4_gpt2_twin as twin

@@ -11,7 +11,7 @@ This probe prints the realized geometry and scan-timed sketch_vec /
 estimate_all for the two production specs plus r=7 variants with pinned
 m and band, so the fix (if any) is a measured geometry pin, not a guess.
 
-    python scripts/r5_r7probe.py
+    python scripts/archive/r5_r7probe.py
 """
 
 from __future__ import annotations
@@ -19,7 +19,8 @@ from __future__ import annotations
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import jax

@@ -16,8 +16,8 @@ If a knob recovers >2 pts, dense was NOT at its task ceiling and the
 north-star row needs re-running (VERDICT's criterion). Any variant that
 moves gets an lr confirmation at 0.4/1.2 (`one --lr`).
 
-    python scripts/r5_residual.py grid
-    python scripts/r5_residual.py one --name no_augment --lr 1.2
+    python scripts/archive/r5_residual.py grid
+    python scripts/archive/r5_residual.py one --name no_augment --lr 1.2
 """
 
 from __future__ import annotations
@@ -27,7 +27,8 @@ import sys
 import time
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 from labutil import ROOT, log_json

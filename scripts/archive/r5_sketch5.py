@@ -12,8 +12,8 @@ keep upload bytes identical (the table IS the upload):
   * k = 100k (extraction width; bytes unchanged, more mass recovered per
     round at d/c = 13 where collisions are mild)
 
-    python scripts/r5_sketch5.py grid
-    python scripts/r5_sketch5.py one --lr 0.03 --pivot 2 --k 50000
+    python scripts/archive/r5_sketch5.py grid
+    python scripts/archive/r5_sketch5.py one --lr 0.03 --pivot 2 --k 50000
 """
 
 from __future__ import annotations
@@ -22,12 +22,13 @@ import argparse
 import sys
 from pathlib import Path
 
-sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+sys.path.insert(1, str(Path(__file__).resolve().parents[2] / "scripts"))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 import r4_retune as retune
 
-retune.LOG = Path(__file__).resolve().parent.parent / "runs" / "r5_sketch5.log"
+retune.LOG = Path(__file__).resolve().parents[2] / "runs" / "r5_sketch5.log"
 
 BASE = dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
             num_rows=5, num_cols=500_000, fuse_clients=True)

@@ -1,20 +1,31 @@
-"""Lint: compression-mode dispatch must not leak out of compress/.
+"""Lint: registry-keyed dispatch must not leak out of its home package.
 
 The compress/ registry refactor (PR 2) moved every mode's algebra behind
-``compress.get_compressor``; the invariant that keeps a new compressor a
-one-file PR is that NOBODY else branches on mode strings. This script
-walks the ``commefficient_tpu`` package ASTs and fails on any
+``compress.get_compressor``; the control/ subsystem (PR 8) did the same
+for rung-selection policies behind ``control.policy.get_policy``. The
+invariant that keeps a new compressor (or policy) a one-file PR is that
+NOBODY else branches on the registry's key strings. This script walks the
+``commefficient_tpu`` package ASTs and fails on any
 
-  * comparison involving a ``mode`` name/attribute
-    (``cfg.mode == "sketch"``, ``mode != 'fedavg'``, ``cfg.mode in (...)``),
-  * dict/registry subscript keyed by a ``mode`` expression
-    (``{...}[cfg.mode]``),
-  * ``match cfg.mode:`` statement,
+  * comparison involving a dispatch name/attribute
+    (``cfg.mode == "sketch"``, ``mode != 'fedavg'``,
+    ``cfg.control_policy in (...)``),
+  * dict/registry subscript keyed by a dispatch expression
+    (``{...}[cfg.mode]``, ``POLICIES[cfg.control_policy]``),
+  * ``match cfg.mode:`` / ``match cfg.control_policy:`` statement,
 
-outside the allowlist: ``compress/`` (the registry owns mode dispatch) and
-``utils/config.py`` (CLI validation + mode-derived conveniences like
-``round_microbatches`` live with the flag definitions). AST-based so
-docstrings/comments that merely MENTION modes never false-positive.
+outside that family's allowlist:
+
+  * ``mode``           -> ``compress/`` (the registry owns mode dispatch)
+                          + ``utils/config.py`` (CLI validation and
+                          mode-derived conveniences like
+                          ``round_microbatches`` live with the flags)
+  * ``control_policy`` -> ``control/`` (the policy registry)
+                          + ``utils/config.py`` (flag validation; other
+                          layers gate on ``cfg.control_enabled``)
+
+AST-based so docstrings/comments that merely MENTION modes or policies
+never false-positive.
 
 Scope is the library package only: tests, bench.py, and scripts are
 harnesses that parametrize over modes by construction. Wired into tier-1
@@ -32,58 +43,77 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "commefficient_tpu"
 
-# paths (relative to the package root) where mode dispatch is LEGAL
-ALLOWED = ("compress/", "utils/config.py")
+# dispatch family -> (paths, relative to the package root, where that
+# family's dispatch is LEGAL)
+FAMILIES = {
+    "mode": ("compress/", "utils/config.py"),
+    "control_policy": ("control/", "utils/config.py"),
+}
 
 
-def _is_modeish(node: ast.AST) -> bool:
-    """True for expressions naming the mode: ``mode``, ``*.mode``."""
-    if isinstance(node, ast.Name) and node.id == "mode":
-        return True
-    if isinstance(node, ast.Attribute) and node.attr == "mode":
-        return True
-    return False
+def _dispatch_name(node: ast.AST):
+    """The family name for expressions naming a dispatch key (``mode``,
+    ``*.mode``, ``control_policy``, ``*.control_policy``), else None."""
+    if isinstance(node, ast.Name) and node.id in FAMILIES:
+        return node.id
+    if isinstance(node, ast.Attribute) and node.attr in FAMILIES:
+        return node.attr
+    return None
 
 
-def scan_file(path: Path) -> list:
-    """[(lineno, snippet)] of mode-dispatch violations in one file."""
+def scan_file(path: Path, families=None) -> list:
+    """[(lineno, family, snippet)] of dispatch violations in one file.
+    ``families``: restrict to these family names (default: all)."""
     src = path.read_text()
     try:
         tree = ast.parse(src, filename=str(path))
     except SyntaxError as e:  # a broken file is its own CI problem
-        return [(e.lineno or 0, f"unparseable: {e.msg}")]
+        return [(e.lineno or 0, "?", f"unparseable: {e.msg}")]
     lines = src.splitlines()
     out = []
 
-    def hit(node):
+    def hit(node, family):
+        if families is not None and family not in families:
+            return
         ln = getattr(node, "lineno", 0)
         snippet = lines[ln - 1].strip() if 0 < ln <= len(lines) else ""
-        out.append((ln, snippet))
+        out.append((ln, family, snippet))
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Compare):
-            if _is_modeish(node.left) or any(
-                _is_modeish(c) for c in node.comparators
-            ):
-                hit(node)
+            for expr in [node.left, *node.comparators]:
+                fam = _dispatch_name(expr)
+                if fam is not None:
+                    hit(node, fam)
+                    break
         elif isinstance(node, ast.Subscript):
-            if _is_modeish(node.slice):
-                hit(node)
+            fam = _dispatch_name(node.slice)
+            if fam is not None:
+                hit(node, fam)
         elif isinstance(node, ast.Match):
-            if _is_modeish(node.subject):
-                hit(node)
-    return out
+            fam = _dispatch_name(node.subject)
+            if fam is not None:
+                hit(node, fam)
+    return sorted(out)  # ast.walk is BFS; report in source order
 
 
 def scan_package(package_root: Path = PACKAGE) -> dict:
-    """{relative_path: [(lineno, snippet)]} over the package, allowlist
-    applied."""
+    """{relative_path: [(lineno, family, snippet)]} over the package,
+    per-family allowlists applied."""
     violations = {}
     for path in sorted(package_root.rglob("*.py")):
         rel = path.relative_to(package_root).as_posix()
-        if any(rel == a or rel.startswith(a) for a in ALLOWED):
+        # only lint each family where its own allowlist does NOT cover
+        # this file — a file may be home to one family and off-limits to
+        # the other (utils/config.py is allowlisted for both; control/
+        # may validate policies but not branch on cfg.mode)
+        banned = tuple(
+            fam for fam, allowed in FAMILIES.items()
+            if not any(rel == a or rel.startswith(a) for a in allowed)
+        )
+        if not banned:
             continue
-        hits = scan_file(path)
+        hits = scan_file(path, families=banned)
         if hits:
             violations[rel] = hits
     return violations
@@ -92,16 +122,19 @@ def scan_package(package_root: Path = PACKAGE) -> dict:
 def main() -> int:
     violations = scan_package()
     for rel, hits in violations.items():
-        for ln, snippet in hits:
-            print(f"commefficient_tpu/{rel}:{ln}: mode-string dispatch "
-                  f"outside compress/: {snippet}")
+        for ln, fam, snippet in hits:
+            home = FAMILIES.get(fam, ("?",))[0]
+            print(f"commefficient_tpu/{rel}:{ln}: {fam}-string dispatch "
+                  f"outside {home}: {snippet}")
     if violations:
         n = sum(len(h) for h in violations.values())
         print(f"\n{n} violation(s). Mode dispatch belongs in "
-              "commefficient_tpu/compress/ (the registry) or "
-              "utils/config.py (flag validation/conveniences); route "
-              "other layers through compress.get_compressor / Config "
-              "properties.")
+              "commefficient_tpu/compress/ (the registry), policy "
+              "dispatch in commefficient_tpu/control/, or utils/config.py "
+              "(flag validation/conveniences); route other layers through "
+              "compress.get_compressor / control.build_controller / "
+              "Config properties (cfg.control_enabled, "
+              "cfg.round_microbatches).")
         return 1
     return 0
 

@@ -47,13 +47,17 @@ from pathlib import Path
 # accounting (live_client_rounds/avail_client_rounds + exactness
 # invariant), flight participation_history; v3 (compiled-graph
 # observability PR): xla/* scalar namespace, perf_report.json,
-# spans_*.json, header/flight "artifacts" block. v1/v2 artifacts stay
-# valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3)
+# spans_*.json, header/flight "artifacts" block; v4 (adaptive
+# communication-budget PR): control/* scalar namespace, the ledger's
+# per-rung "rungs" accounting block (cum bytes == sum over rungs of
+# active-rung bytes, live-count-weighted under masking), header/flight
+# "controller" block. Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
-SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/")
+SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
+                   "control/")
 
 
 class SchemaError(ValueError):
@@ -92,12 +96,35 @@ def _check_version(record: dict, where: str) -> None:
         )
 
 
+def _check_controller_block(block: dict, where: str) -> None:
+    """The v4 controller block (metrics run-header + flight dumps):
+    enough to attribute a record to its rung/policy — policy + ladder
+    identity, the rung at write/dump time, and (flight dumps) the switch
+    count and budget state."""
+    _req(block, "policy", str, where)
+    _req(block, "ladder", str, where)
+    rung = _req(block, "rung", int, where)
+    n = _req(block, "num_rungs", int, where)
+    if n < 1 or not 0 <= rung < n:
+        raise SchemaError(
+            f"{where}: rung {rung} outside [0, num_rungs={n})"
+        )
+    for f in ("switches", "rounds_seen", "budget_bytes",
+              "budget_remaining_bytes"):
+        if f in block and not isinstance(block[f], int):
+            raise SchemaError(f"{where}: {f} must be an int")
+
+
 def _check_header(rec: dict, where: str) -> None:
     _check_version(rec, where)
     _req(rec, "time", (int, float), where)
     _req(rec, "start_time", str, where)
     if "config" in rec:
         _req(rec, "config", dict, where)
+    if "controller" in rec:
+        _check_controller_block(
+            _req(rec, "controller", dict, where), where + ":controller"
+        )
     if "artifacts" in rec:
         # v3: links to this run's profiling evidence (StepProfiler trace
         # logdir, perf_report.json path) — string values only
@@ -229,6 +256,56 @@ def validate_comm_ledger(path) -> dict:
                 f"{where}: avail_client_rounds {avail} outside "
                 f"[live_client_rounds, rounds * num_workers]"
             )
+    if "rungs" in rec:
+        # v4 control/ ladder accounting: each round billed at its ACTIVE
+        # rung's rate — the invariant is the sum over rungs of that
+        # rung's rounds (live/avail counts when masked) x its
+        # bytes_per_round. Exact ints, no tolerance, like the flat law.
+        rungs = _req(rec, "rungs", list, where)
+        if not rungs:
+            raise SchemaError(f"{where}: empty rungs block")
+        up_want = down_want = rounds_sum = 0
+        live_sum = avail_sum = 0
+        for i, r in enumerate(rungs):
+            w = f"{where}:rungs[{i}]"
+            if not isinstance(r, dict):
+                raise SchemaError(f"{w}: expected an object")
+            rb = _req(r, "bytes_per_round", dict, w)
+            for k in ("upload_bytes", "download_bytes"):
+                if not isinstance(rb.get(k), int):
+                    raise SchemaError(
+                        f"{w}: bytes_per_round[{k!r}] missing or not an int"
+                    )
+            n_r = _req(r, "rounds", int, w)
+            if n_r < 0:
+                raise SchemaError(f"{w}: negative rounds")
+            rounds_sum += n_r
+            if masked:
+                live_r = _req(r, "live_client_rounds", int, w)
+                avail_r = _req(r, "avail_client_rounds", int, w)
+                live_sum += live_r
+                avail_sum += avail_r
+                up_want += live_r * rb["upload_bytes"]
+                down_want += avail_r * rb["download_bytes"]
+            else:
+                up_want += n_r * rb["upload_bytes"]
+                down_want += n_r * rb["download_bytes"]
+        if rounds_sum != rounds:
+            raise SchemaError(
+                f"{where}: per-rung rounds sum to {rounds_sum}, ledger "
+                f"counted {rounds}"
+            )
+        if masked and (live_sum != live or avail_sum != avail):
+            raise SchemaError(
+                f"{where}: per-rung live/avail client-rounds "
+                f"({live_sum}/{avail_sum}) != ledger totals "
+                f"({live}/{avail})"
+            )
+        up_law = ("sum_r live_r * up_r" if masked
+                  else "sum_r rounds_r * up_r")
+        down_law = ("sum_r avail_r * down_r" if masked
+                    else "sum_r rounds_r * down_r")
+    elif masked:
         up_want, down_want = (live * bpr["upload_bytes"],
                               avail * bpr["download_bytes"])
         up_law = "live_client_rounds * upload_bytes"
@@ -270,6 +347,13 @@ def validate_flight(path) -> dict:
         raise SchemaError(
             f"{where}: {len(records)} records exceed the ring window "
             f"{window}"
+        )
+    if "controller" in rec:
+        # v4 ladder runs: the dump-time controller state surfaced
+        # top-level by FlightRecorder.dump — a divergence is attributable
+        # to a rung switch from here + the per-record control/rung scalars
+        _check_controller_block(
+            _req(rec, "controller", dict, where), where + ":controller"
         )
     if "participation_history" in rec:
         # fedsim runs: the [step, participation_rate] window surfaced

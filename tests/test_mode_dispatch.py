@@ -1,10 +1,13 @@
-"""The compress/ subsystem boundary, enforced in tier-1.
+"""The compress/ and control/ subsystem boundaries, enforced in tier-1.
 
-Two invariants: (1) no mode-string dispatch outside compress/ +
-utils/config.py (scripts/check_mode_dispatch.py, so the registry boundary
-can't silently erode), and (2) the registry and the CLI's MODES tuple stay
-in sync (a registered-but-unlisted mode would be unreachable from the CLI;
-a listed-but-unregistered one would crash at session build)."""
+Three invariants: (1) no mode-string dispatch outside compress/ +
+utils/config.py and no control_policy-string dispatch outside control/ +
+utils/config.py (scripts/check_mode_dispatch.py, so the registry
+boundaries can't silently erode), (2) the compress registry and the CLI's
+MODES tuple stay in sync, and (3) the control policy registry and the
+CLI's CONTROL_POLICIES tuple stay in sync (a registered-but-unlisted
+entry would be unreachable from the CLI; a listed-but-unregistered one
+would crash at build)."""
 
 import importlib.util
 import os
@@ -29,11 +32,11 @@ def test_no_mode_dispatch_outside_compress():
     lint = _lint()
     violations = lint.scan_package()
     assert not violations, (
-        "mode-string dispatch leaked outside compress/ + utils/config.py:\n"
+        "registry-keyed dispatch leaked outside its home package:\n"
         + "\n".join(
-            f"  commefficient_tpu/{rel}:{ln}: {snip}"
+            f"  commefficient_tpu/{rel}:{ln} [{fam}]: {snip}"
             for rel, hits in violations.items()
-            for ln, snip in hits
+            for ln, fam, snip in hits
         )
     )
 
@@ -54,7 +57,9 @@ def test_lint_actually_detects_violations(tmp_path):
         "    s = \"docstrings mentioning mode == 'sketch' neither\"\n"
     )
     hits = lint.scan_file(bad)
-    assert [ln for ln, _ in hits] == [2, 4, 6]
+    assert [(ln, fam) for ln, fam, _ in hits] == [
+        (2, "mode"), (4, "mode"), (6, "mode")
+    ]
 
     clean = tmp_path / "clean.py"
     clean.write_text(
@@ -66,15 +71,79 @@ def test_lint_actually_detects_violations(tmp_path):
     assert lint.scan_file(clean) == []
 
 
-def test_lint_allowlists_compress_and_config():
+def test_lint_detects_control_policy_dispatch(tmp_path):
+    """The control_policy family (PR 8): branching on the policy string
+    outside control/ must be flagged, through every node kind the lint
+    claims (Compare / Subscript / match); gating on cfg.control_enabled
+    must NOT be."""
+    lint = _lint()
+    bad = tmp_path / "bad_ctrl.py"
+    bad.write_text(
+        "def f(cfg):\n"
+        "    if cfg.control_policy == 'ef_feedback':\n"
+        "        pass\n"
+        "    h = {'fixed': 1}[cfg.control_policy]\n"
+        "    match cfg.control_policy:\n"
+        "        case 'none':\n"
+        "            pass\n"
+    )
+    hits = lint.scan_file(bad)
+    assert [(ln, fam) for ln, fam, _ in hits] == [
+        (2, "control_policy"), (4, "control_policy"),
+        (5, "control_policy"),
+    ]
+
+    clean = tmp_path / "clean_ctrl.py"
+    clean.write_text(
+        "def g(cfg, session):\n"
+        "    if cfg.control_enabled:\n"
+        "        pass\n"
+        "    return cfg.control_policy  # reading it is fine\n"
+    )
+    assert lint.scan_file(clean) == []
+
+
+def test_lint_family_restriction(tmp_path):
+    """scan_file(families=...) is what scan_package uses to apply
+    per-family allowlists — a file allowed for one family must still be
+    linted for the other."""
+    lint = _lint()
+    mixed = tmp_path / "mixed.py"
+    mixed.write_text(
+        "def f(cfg):\n"
+        "    if cfg.mode == 'sketch':\n"
+        "        pass\n"
+        "    if cfg.control_policy == 'fixed':\n"
+        "        pass\n"
+    )
+    only_mode = lint.scan_file(mixed, families=("mode",))
+    assert [(ln, fam) for ln, fam, _ in only_mode] == [(2, "mode")]
+    only_ctrl = lint.scan_file(mixed, families=("control_policy",))
+    assert [(ln, fam) for ln, fam, _ in only_ctrl] == [
+        (4, "control_policy")
+    ]
+
+
+def test_lint_allowlists_compress_config_and_control():
     lint = _lint()
     pkg = os.path.join(REPO, "commefficient_tpu")
     # the allowed homes really do contain dispatch (sanity: the allowlist
     # is load-bearing, not decorative)
-    reg = lint.scan_file(
-        __import__("pathlib").Path(pkg, "utils", "config.py")
+    from pathlib import Path
+
+    cfg_hits = lint.scan_file(Path(pkg, "utils", "config.py"))
+    assert any(fam == "mode" for _, fam, _ in cfg_hits), (
+        "utils/config.py is expected to branch on mode (validation)"
     )
-    assert reg, "utils/config.py is expected to branch on mode (validation)"
+    assert any(fam == "control_policy" for _, fam, _ in cfg_hits), (
+        "utils/config.py is expected to branch on control_policy "
+        "(validation)"
+    )
+    pol_hits = lint.scan_file(Path(pkg, "control", "policy.py"))
+    assert any(fam == "control_policy" for _, fam, _ in pol_hits), (
+        "control/policy.py is expected to branch on control_policy "
+        "(the policy registry)"
+    )
 
 
 def test_registry_matches_config_modes():
@@ -82,6 +151,13 @@ def test_registry_matches_config_modes():
     from commefficient_tpu.utils.config import MODES
 
     assert set(available_modes()) == set(MODES)
+
+
+def test_policy_registry_matches_config_policies():
+    from commefficient_tpu.control.policy import POLICIES
+    from commefficient_tpu.utils.config import CONTROL_POLICIES
+
+    assert set(POLICIES) | {"none"} == set(CONTROL_POLICIES)
 
 
 def test_unknown_mode_rejected_with_registered_list():

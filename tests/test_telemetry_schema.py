@@ -197,6 +197,70 @@ def test_checker_rejects_unknown_schema_version(tmp_path):
         mod.validate_comm_ledger(path)
 
 
+class _FakeController:
+    def snapshot(self):
+        return {"policy": "fixed", "ladder": "k=20,10", "rung": 1,
+                "num_rungs": 2, "switches": 1, "rounds_seen": 3,
+                "last_switch_round": 2}
+
+
+def test_flight_controller_block_validates_and_rejects(tmp_path):
+    """v4: a controller-attached flight dump carries the dump-time
+    controller snapshot; the checker validates it and rejects an
+    out-of-range rung."""
+    cfg = Config(mode="uncompressed", telemetry_level=1)
+    flight = FlightRecorder(cfg, logdir=str(tmp_path),
+                            controller=_FakeController())
+    flight.record(0, 0.1, {"loss": 1.0})
+    path = flight.dump(0, reason="test", first_bad_step=None)
+    mod = _checker()
+    rec = mod.validate_flight(path)
+    assert rec["controller"]["rung"] == 1
+    rec["controller"]["rung"] = 5  # outside num_rungs
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="num_rungs"):
+        mod.validate_flight(path)
+
+
+def test_header_controller_block_validates_and_rejects(tmp_path):
+    """v4: the metrics run-header carries the controller identity block
+    (MetricsWriter extra_header); the checker validates it."""
+    cfg = Config(mode="uncompressed", telemetry_level=1)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg, extra_header={
+        "controller": {"policy": "ef_feedback", "ladder": "k=20,10",
+                       "rung": 1, "num_rungs": 2},
+    })
+    writer.scalar("control/rung", 1.0, 0)
+    writer.close()
+    mod = _checker()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    mod.validate_metrics_jsonl(path)
+    # a malformed block (missing policy) must fail
+    with open(path) as f:
+        lines = f.read().splitlines()
+    header = json.loads(lines[0])
+    del header["controller"]["policy"]
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n" + "\n".join(lines[1:]) + "\n")
+    with pytest.raises(mod.SchemaError, match="policy"):
+        mod.validate_metrics_jsonl(path)
+
+
+def test_checker_rejects_unknown_control_scalar_only_outside_prefix(
+        tmp_path):
+    """control/ is a documented v4 prefix; names under it pass, the
+    namespace boundary still rejects others."""
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+    path = os.path.join(run_dir, "metrics.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps({"name": "control/budget_remaining_bytes",
+                            "value": 123.0, "step": 9, "t": 0.0}) + "\n")
+    mod.validate_metrics_jsonl(path)
+
+
 def test_cli_exit_codes(tmp_path):
     mod = _checker()
     run_dir = _write_run(tmp_path)
