@@ -21,6 +21,13 @@ end (steady-state pipelined dispatch); a lax.scan-of-rounds variant was
 measured ~50x slower through the axon tunnel runtime
 (scripts/profile_scan.py) and is NOT used.
 
+Pipelined leg (pipeline/ PR): ``sketch_pipelined_*`` keys on the headline
+line measure the depth-2 pipelined engine against its synchronous twin on
+the SAME session — both paying real per-round host work (sampler batch
+assembly + H2D), since that host serial time is what the pipeline hides;
+the engine's mean occupancy and residual host stall ride along
+(check_bench_regression gates samples/s + occupancy).
+
 GPT-2 legs: the BASELINE #4 sketch round rides the headline line per
 SKETCH BACKEND (einsum = legacy keys, pallas = ``gpt2_sketch_pallas_*``)
 next to its uncompressed twin — the r5 VERDICT's 3.5x sketch-round gap is
@@ -291,11 +298,11 @@ def _measure(cfg, n_rounds: int = 20, audit_box: dict = None) -> float:
         from commefficient_tpu.fedsim import build_environment
 
         fe = build_environment(cfg)
-        envs = []
-        for r in range(3 + n_rounds):
-            e = fe.round_env(r)
-            envs.append((jnp.asarray(e.live), jnp.asarray(e.corrupt),
-                         jnp.float32(e.live_count)))
+        envs = [
+            (jnp.asarray(e.live), jnp.asarray(e.corrupt),
+             jnp.float32(e.live_count))
+            for e in fe.round_envs(0, 3 + n_rounds)
+        ]
 
     # compile + warmup: the first TWO calls compile (donated-buffer layouts
     # differ between the fresh state and the returned state), so warm both.
@@ -321,6 +328,86 @@ def _measure(cfg, n_rounds: int = 20, audit_box: dict = None) -> float:
         audit_box["_audit"] = audit
         audit_box["_cfg"] = cfg
     return sps
+
+
+def _measure_pipeline(base_cfg, n_rounds: int = 8, depth: int = 2) -> dict:
+    """Pipelined round execution (pipeline/ PR) vs its synchronous twin on
+    the headline sketch round, through the REAL engine. Unlike the other
+    legs' device-resident batches, BOTH twins pay real per-round host
+    work — non-IID sampler draw + [W*B] batch assembly + H2D ``device_put``
+    — because that host serial time is exactly what the pipeline hides.
+    The sync twin runs it on the critical path between dispatches (the
+    depth-0 train loop); the pipelined twin stages ``depth`` rounds ahead
+    on the worker thread. Reports samples/s for both, the engine's mean
+    occupancy/residual host stall, and ``host_stall_delta_ms`` = mean
+    per-round host realization time minus the residual stall — the host
+    milliseconds per round the pipeline moved off the critical path."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.data import FedDataset, FedSampler
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.pipeline import PipelinedRounds
+    from commefficient_tpu.utils.profiling import fence
+
+    cfg = base_cfg.replace(pipeline_depth=depth, device_data=False)
+    W, B = cfg.num_workers, cfg.local_batch_size
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply, compute_dtype=cfg.compute_dtype)
+    session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+    rng = np.random.default_rng(0)
+    n = 4 * W * B  # enough rows that per-client draws stay CIFAR-shaped
+    ds = FedDataset(
+        {"x": rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8),
+         "y": rng.integers(0, 10, size=(n,)).astype(np.int32)},
+        cfg.num_clients, iid=True, seed=0,
+    )
+    sampler = FedSampler(ds, num_workers=W, local_batch_size=B, seed=0)
+
+    def lr_fn(_step):
+        return 0.1
+
+    def run_sync(start):
+        t0 = time.perf_counter()
+        for r in range(start, start + n_rounds):
+            ids, batch = sampler.sample_round(r)
+            m = session.train_round(ids, batch, 0.1)
+        fence(m["loss"])
+        return time.perf_counter() - t0
+
+    # compile + warm both donated-buffer layouts (bench warmup discipline)
+    run_sync(0)
+    dt_sync = run_sync(n_rounds)
+    start = 2 * n_rounds
+    stop = start + n_rounds
+    engine = PipelinedRounds(
+        cfg, session, sampler, lr_fn, num_rounds=stop, steps_per_epoch=stop
+    ).start(start)
+    try:
+        t0 = time.perf_counter()
+        for _s, _lr, m in engine.epoch_rounds(0, start):
+            pass
+        fence(m["loss"])
+        dt_pipe = time.perf_counter() - t0
+    finally:
+        engine.close()
+    st = engine.stats()
+    return {
+        "sketch_pipelined_samples_per_sec": round(n_rounds * W * B / dt_pipe, 2),
+        "sketch_pipeline_sync_samples_per_sec": round(
+            n_rounds * W * B / dt_sync, 2
+        ),
+        "sketch_pipelined_sec_per_round": round(dt_pipe / n_rounds, 4),
+        "sketch_pipelined_depth": depth,
+        "sketch_pipelined_occupancy": round(st["occupancy"], 4),
+        "sketch_pipelined_host_stall_ms": round(st["host_stall_ms"], 2),
+        "sketch_pipelined_host_stall_delta_ms": round(
+            st["prefetch_host_ms"] - st["host_stall_ms"], 2
+        ),
+    }
 
 
 def _measure_ladder_switch(base_cfg, n_rounds: int = 8) -> dict:
@@ -473,6 +560,19 @@ def main():
             rows.update(ctl)
             print(json.dumps({"metric": "sketch_ladder_switch", **ctl}))
 
+    # pipeline PR: the pipelined-execution leg rides the HEADLINE line
+    # (gated by scripts/check_bench_regression.py — occupancy + samples/s
+    # directions registered there), with the same per-leg error isolation
+    # as the GPT-2 legs: an engine failure must not discard the headline.
+    pipe: dict = {}
+    try:
+        pipe = _measure_pipeline(_headline_cfg())
+        print(json.dumps({"metric": "sketch_pipelined", **pipe}))
+    except Exception as e:  # noqa: BLE001
+        pipe = {"sketch_pipelined_error": f"{type(e).__name__}: {e}"[:200]}
+        print(json.dumps({"metric": "sketch_pipelined",
+                          "error": pipe["sketch_pipelined_error"]}))
+
     audit_box: dict = {}
     headline = _measure(_headline_cfg(), audit_box=audit_box)
     headline_audit = audit_box.pop("_audit", None)
@@ -585,6 +685,9 @@ def main():
         # audited twin of mfu/headline from the compiled round artifact
         # (telemetry/xla_audit.py; `audit_error` when it degraded)
         **audit_box,
+        # pipelined-execution leg (pipeline/ PR): depth-2 vs synchronous
+        # host staging, engine occupancy + residual host stall
+        **pipe,
         **gpt2,
     }
     if assumed:
@@ -605,6 +708,7 @@ def main():
         if assumed:  # same in-band marker as the headline line
             rows["peak_flops_assumed"] = peak
         rows.update(audit_box)
+        rows.update(pipe)
         rows.update(gpt2)
         with open("BENCH_MATRIX.json", "w") as f:
             json.dump(rows, f, indent=2)

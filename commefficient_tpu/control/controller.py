@@ -76,7 +76,23 @@ class BudgetController:
         self.spent_up = 0
         self.spent_down = 0
         self.last_switch_round = -1
+        # rung-switch observers (pipeline/engine.py registers one): called
+        # host-side, AFTER the dispatch-table swap + state migration and
+        # BEFORE the round dispatches — the pipelined engine's quiesce
+        # point. ``on_round_start`` stays a PRE-STAGING barrier in the
+        # pipeline sense: staged work is rung-INVARIANT (batch geometry,
+        # env masks and lr never depend on the rung), so a switch
+        # invalidates nothing in the in-flight window, and every rung's
+        # program is AOT-prewarmed — the listener lets the engine account/
+        # span the quiesce without re-deriving any of that.
+        self._switch_listeners = []
         session.controller = self
+
+    def add_switch_listener(self, fn) -> None:
+        """Register ``fn(step, old_rung, new_rung)``, called at each rung
+        switch (see ``_switch_listeners`` above). Listeners must be pure
+        observers — raising would abort the round the switch serves."""
+        self._switch_listeners.append(fn)
 
     # -- byte accounting (mirrors telemetry.CommLedger exactly) ------------
     def _live_avail(self, fs_stats: Optional[Dict[str, float]]):
@@ -150,6 +166,8 @@ class BudgetController:
             self.session.set_active_rung(target, migrate=True)
             self.switches += 1
             self.last_switch_round = step
+            for fn in self._switch_listeners:
+                fn(step, rung, target)
         self._spend(target, live, avail)
         self.rounds_seen += 1
         return target

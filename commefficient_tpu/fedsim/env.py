@@ -73,9 +73,22 @@ class FedEnvironment:
         callable only where the run length is known (the train entries)."""
         validate_chaos_rounds(self.plan, num_rounds)
 
+    def round_envs(self, start: int, stop: int):
+        """Yield ``round_env(r)`` for r in [start, stop) — the pipeline
+        prefetcher's (and bench's) bulk-realization form. Each env is a
+        pure function of ``(seed, FEDSIM_STREAM, round_idx)`` with no
+        shared mutable state, so realization commutes with execution:
+        prefetching round t+k's environment from a worker thread while
+        round t computes yields bit-identical masks to realizing it
+        synchronously (the pipeline/ determinism contract leans on this)."""
+        for r in range(start, stop):
+            yield self.round_env(r)
+
     def round_env(self, round_idx: int) -> RoundEnv:
         """Realize round ``round_idx``'s masks + telemetry scalars —
-        deterministic and resume-stable from (seed, round_idx)."""
+        deterministic and resume-stable from (seed, round_idx). Pure and
+        thread-safe: a fresh rng per call, nothing mutated (see
+        ``round_envs``)."""
         W = self.num_workers
         rng = round_rng(self.seed, round_idx)
         avail = sample_availability(

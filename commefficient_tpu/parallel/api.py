@@ -618,6 +618,50 @@ class FederatedSession:
             donate_argnums=(0,),
         )
 
+    # -- eager H2D staging (pipeline/ prefetch lane) -----------------------
+    def stage_round_payload(self, client_ids, batch):
+        """Commit one round's host batch to the mesh EAGERLY — the
+        pipeline prefetcher's H2D lane: round t+1's arrays start their
+        host->device copy while round t computes. Returns
+        ``(client_ids_np, dev_batch)``; committed arrays pass through the
+        dispatch-time ``device_put`` in ``train_round`` as an identity
+        (same sharding, no copy), so a staged round dispatches with zero
+        H2D on the critical path. Safe from a worker thread (pure
+        ``device_put``, no tracing, no session state touched). client_ids
+        stay host-side numpy: the offload path indexes host stores with
+        them, and at [W] ints their dispatch-time put is noise."""
+        cids = np.asarray(client_ids)
+        dev_batch = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
+            batch,
+        )
+        return cids, dev_batch
+
+    def stage_round_indices(self, client_ids, idx, plan):
+        """``stage_round_payload`` for the device-resident index round:
+        commits the [W, B] sample indices and the augmentation plan (the
+        only per-round H2D traffic on that path). Returns
+        ``(client_ids_np, idx_dev, plan_dev)``."""
+        cids = np.asarray(client_ids)
+        idxd = jax.device_put(
+            jnp.asarray(idx if isinstance(idx, jax.Array)
+                        else np.asarray(idx, np.int32)),
+            self._batch_sharding,
+        )
+        pl = (
+            tuple(
+                jax.device_put(
+                    jnp.asarray(a if isinstance(a, jax.Array)
+                                else np.asarray(a)),
+                    self._replicated,
+                )
+                for a in plan
+            )
+            if plan
+            else ()
+        )
+        return cids, idxd, pl
+
     # -- fedsim (fedsim/: availability masking + chaos) --------------------
     def sync_round_clock(self) -> None:
         """Align the host round clock — which drives the fedsim
@@ -683,18 +727,8 @@ class FederatedSession:
     def train_round_indices(self, client_ids, idx, plan, lr: float, env=None):
         """Run one round from device-resident data (see ``attach_data``)."""
         with self._span("device_put"):
-            ids = jax.device_put(jnp.asarray(client_ids), self._batch_sharding)
-            idxd = jax.device_put(
-                jnp.asarray(np.asarray(idx, np.int32)), self._batch_sharding
-            )
-            pl = (
-                tuple(
-                    jax.device_put(jnp.asarray(np.asarray(a)), self._replicated)
-                    for a in plan
-                )
-                if plan
-                else ()
-            )
+            cids, idxd, pl = self.stage_round_indices(client_ids, idx, plan)
+            ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         with self._span("fedsim_env"):
             fs_env, fs_stats = self._fedsim_round_env(env)
         self._control_round_start(fs_stats)
@@ -712,13 +746,9 @@ class FederatedSession:
     # -- train ------------------------------------------------------------
     def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray],
                     lr: float, env=None):
-        cids = np.asarray(client_ids)
         with self._span("device_put"):
+            cids, dev_batch = self.stage_round_payload(client_ids, batch)
             ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
-            dev_batch = jax.tree.map(
-                lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
-                batch,
-            )
         lr = jnp.float32(lr)
         with self._span("fedsim_env"):
             fs_env, fs_stats = self._fedsim_round_env(env)

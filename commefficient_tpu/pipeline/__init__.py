@@ -1,0 +1,44 @@
+"""Pipelined round execution — overlap host staging and H2D with device
+compute.
+
+FetchSGD's round loop is dispatch-bound on the device, but every round
+used to pay its host serial time FIRST: client sampling + batch assembly,
+fedsim environment realization, schedule lr, and the ``device_put`` H2D
+copy all ran on the critical path before ``round_dispatch`` (the PR-7
+phase spans measure each). Like sketched-SGD's pipelined worker loop
+(arXiv:1903.04488 §5), round t+1's host work is independent of round t's
+result — every rng stream in this repo is a pure function of
+``(seed, stream, round_idx)`` — so it can be realized ahead, bit-exactly:
+
+  * ``prefetch``: ``RoundPrefetcher`` — a bounded-depth worker thread
+    realizing ``RoundWork`` items up to ``--pipeline_depth`` rounds ahead,
+    with eager H2D staging through the session's own sharding objects.
+  * ``engine``: ``PipelinedRounds`` — the driver owning the in-flight
+    window and the determinism contracts (controller barrier order,
+    policy-lag rule, checkpoint fencing, crash draining); see its module
+    docstring.
+
+``--pipeline_depth 0`` (default) constructs NOTHING: the train loops keep
+the legacy synchronous path, golden parity recordings and level-0 HLO are
+untouched (the telemetry_level-0 / fedsim-always discipline). Any depth
+is bit-exact vs depth 0 (pinned by tests/test_pipeline.py, including
+under fedsim dropout and a mid-run compression-ladder switch).
+
+Layering: this package imports ``parallel`` (the session's staging hooks)
+and is imported only by ``train/`` and bench — nothing below it knows the
+pipeline exists.
+"""
+
+from commefficient_tpu.pipeline.engine import PipelinedRounds
+from commefficient_tpu.pipeline.prefetch import (
+    PrefetchWorkerDied,
+    RoundPrefetcher,
+    RoundWork,
+)
+
+__all__ = [
+    "PipelinedRounds",
+    "PrefetchWorkerDied",
+    "RoundPrefetcher",
+    "RoundWork",
+]

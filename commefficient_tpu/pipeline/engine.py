@@ -1,0 +1,185 @@
+"""PipelinedRounds — the driver that owns the in-flight round window.
+
+The round dispatch itself was already asynchronous (XLA enqueue returns
+immediately); what serialized the loop was everything BEFORE dispatch:
+sampler draw + batch assembly, fedsim environment realization, schedule
+lr, and the ``device_put`` H2D copy — all host-serial on the critical
+path (the PR-7 phase spans measure them per round). This engine moves all
+of it onto the ``RoundPrefetcher``'s worker thread, ``cfg.pipeline_depth``
+rounds ahead, and keeps the DISPATCH ORDER — and with it every
+correctness contract — identical to the synchronous loop:
+
+  * **Controller barrier.** ``BudgetController.on_round_start`` still runs
+    host-side immediately before each round's dispatch (inside
+    ``session.train_round*``), in round order — byte accounting, budget
+    clamps and ``BudgetExhaustedError`` fire exactly where depth 0 fires
+    them. Staged work is rung-INVARIANT (a ladder varies
+    k/num_cols/rank, never batch geometry, env masks or lr), and every
+    rung's round program is AOT-prewarmed, so a rung switch quiesces
+    nothing physical: the dispatch-table swap + state migration happen at
+    the barrier and the staged window dispatches through the NEW rung's
+    prewarmed program — ``xla/retraces`` stays 0 (the engine registers a
+    switch listener purely to mark the quiesce in the span track).
+  * **Policy lag.** Adaptive policies observe drained metrics through the
+    same ``drain_round_metrics`` rider at the same drain points (epoch
+    end, pre-checkpoint) as depth 0 — the engine never drains early, so
+    the observation-before-decision order, and therefore the rung
+    sequence, is a pure function of the run and bit-identical across
+    depths; a checkpoint resume reproduces it (the controller blob saw
+    the same drains).
+  * **Checkpoint fence.** Drains precede saves (the runner's
+    ``will_save`` discipline), and the save itself fetches the device
+    state — the in-flight window holds only FUTURE rounds' pure inputs,
+    so restore is bit-identical to synchronous execution.
+  * **Crash paths.** A worker-thread fault re-raises at the consuming
+    round with its original traceback; the runner's crash flush then
+    drains the dispatched in-flight rounds and the flight dump carries
+    their true round indices — same forensics as a synchronous crash.
+
+``pipeline/*`` telemetry (level >= 1, schema v5) rides each round's
+metric dict: ``pipeline/occupancy`` (staged/depth at fetch, in [0, 1]),
+``pipeline/host_stall_ms`` (time the consumer blocked waiting for staged
+work — the residual host serial time the depth did NOT hide), and
+``pipeline/staged_rounds`` (the integer occupancy numerator).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from commefficient_tpu.pipeline.prefetch import RoundPrefetcher
+
+
+class PipelinedRounds:
+    """One per train loop when ``cfg.pipeline_depth > 0``.
+
+    ``lr_fn`` must be the loop's schedule (pure in the round index);
+    ``num_rounds`` the run length (steps_per_epoch x num_epochs).
+    ``spans``/``profiler`` are the loop's PhaseSpans/StepProfiler (either
+    may be None); the prefetch lane's spans land on the worker thread's
+    own track."""
+
+    def __init__(self, cfg, session, sampler, lr_fn, num_rounds: int,
+                 steps_per_epoch: Optional[int] = None, spans=None,
+                 profiler=None):
+        if cfg.pipeline_depth < 1:
+            raise ValueError(
+                "PipelinedRounds needs cfg.pipeline_depth >= 1 (depth 0 "
+                "is the synchronous loop — build nothing)"
+            )
+        self.cfg = cfg
+        self.session = session
+        self.spans = spans
+        self.profiler = profiler
+        self.depth = int(cfg.pipeline_depth)
+        self.num_rounds = int(num_rounds)
+        self.steps_per_epoch = int(
+            steps_per_epoch if steps_per_epoch is not None
+            else sampler.steps_per_epoch()
+        )
+        self._use_idx = getattr(session, "_dev_data", None) is not None
+        self._sampler = sampler
+        self._lr_fn = lr_fn
+        self._prefetcher: Optional[RoundPrefetcher] = None
+        # running telemetry sums (bench/stats; per-round scalars ride the
+        # metric dicts at telemetry_level >= 1)
+        self._rounds = 0
+        self._stall_ms_sum = 0.0
+        self._occupancy_sum = 0.0
+        self._host_ms_sum = 0.0
+        self.quiesces = 0
+        if session.controller is not None:
+            session.controller.add_switch_listener(self._on_rung_switch)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self, resume_step: int = 0) -> "PipelinedRounds":
+        """Start the run-long prefetcher at ``resume_step`` (the global
+        round the loop will dispatch next — a resumed run's restored
+        step). Idempotent per engine; call once before the epoch loop."""
+        if self._prefetcher is None:
+            self._prefetcher = RoundPrefetcher(
+                session=self.session,
+                sampler=self._sampler,
+                lr_fn=self._lr_fn,
+                depth=self.depth,
+                start_step=int(resume_step),
+                stop_step=self.num_rounds,
+                microbatches=getattr(self.cfg, "round_microbatches", 0),
+                use_indices=self._use_idx,
+                spans=self.spans,
+            ).start()
+        return self
+
+    def close(self) -> None:
+        """Stop + join the prefetch worker (crash paths included — the
+        runner calls this in its finally block)."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+
+    # -- the per-epoch round source (what the runner iterates) -------------
+    def epoch_rounds(self, epoch: int, start_step: int):
+        """Yield ``(step, lr, metrics)`` for epoch ``epoch``'s rounds at or
+        past ``start_step``, dispatching each through the session exactly
+        as the synchronous loop would (same controller barrier, same
+        metric dict — plus the ``pipeline/*`` scalars at level >= 1)."""
+        if self._prefetcher is None:
+            raise RuntimeError("PipelinedRounds.epoch_rounds before start()")
+        spe = self.steps_per_epoch
+        for step in range(max(epoch * spe, start_step), (epoch + 1) * spe):
+            staged = self._prefetcher.staged_rounds
+            t0 = time.perf_counter()
+            work = self._prefetcher.get(step)  # re-raises worker faults
+            stall_ms = (time.perf_counter() - t0) * 1e3
+            if self.profiler is not None:
+                self.profiler.step(step)
+            if self.spans is not None:
+                self.spans.step(step)
+            metrics = self._dispatch(work)
+            occupancy = staged / self.depth
+            self._rounds += 1
+            self._stall_ms_sum += stall_ms
+            self._occupancy_sum += occupancy
+            self._host_ms_sum += work.host_ms
+            if self.cfg.telemetry_level >= 1:
+                # constant key set across the run, as pack_metric_dicts
+                # requires (the xla/retraces discipline)
+                metrics = {
+                    **metrics,
+                    "pipeline/occupancy": float(occupancy),
+                    "pipeline/host_stall_ms": float(stall_ms),
+                    "pipeline/staged_rounds": float(staged),
+                }
+            yield step, work.lr, metrics
+
+    def _dispatch(self, work):
+        sess = self.session
+        if self._use_idx:
+            return sess.train_round_indices(
+                work.client_ids, work.idx, work.plan, work.lr, env=work.env
+            )
+        return sess.train_round(
+            work.client_ids, work.batch, work.lr, env=work.env
+        )
+
+    # -- rung-switch quiesce marker ----------------------------------------
+    def _on_rung_switch(self, step: int, old: int, new: int) -> None:
+        """Controller switch listener: the staged window needs no
+        restaging (rung-invariant inputs; prewarmed per-rung programs),
+        so the quiesce is an accounting/span marker, not a flush."""
+        self.quiesces += 1
+        if self.spans is not None:
+            with self.spans.span(f"pipeline_quiesce:rung{old}->rung{new}",
+                                 step=step):
+                pass
+
+    # -- aggregate stats (bench.py's sketch_pipelined leg) -----------------
+    def stats(self) -> dict:
+        n = max(self._rounds, 1)
+        return {
+            "rounds": self._rounds,
+            "occupancy": self._occupancy_sum / n,
+            "host_stall_ms": self._stall_ms_sum / n,
+            "prefetch_host_ms": self._host_ms_sum / n,
+            "quiesces": self.quiesces,
+        }

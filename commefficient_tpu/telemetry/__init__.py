@@ -99,7 +99,12 @@ from commefficient_tpu.telemetry.xla_audit import (
 # whose cum-bytes invariant is the sum over rungs of active-rung bytes —
 # live-count-weighted under fedsim masking), and the header/flight
 # "controller" block (policy, ladder, rung at write/dump time).
-SCHEMA_VERSION = 4
+# v5 (pipelined round execution PR): the pipeline/* scalar namespace
+# (occupancy in [0, 1], host_stall_ms, the integer staged_rounds — both
+# invariants checker-enforced), and thread-aware spans: per-event lane
+# ``tid``s plus "M" thread_name metadata events labeling the prefetch
+# lane's own track.
+SCHEMA_VERSION = 5
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

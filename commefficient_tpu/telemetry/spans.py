@@ -21,17 +21,34 @@ span is the real per-round device+host latency. At telemetry level 0 the
 train loops construct no recorder at all — zero host work, and nothing in
 the jitted program either way (spans are pure host code).
 
+Thread-awareness (schema v5): spans record the CALLING thread as a small
+lane id in ``tid`` — the constructing thread is lane 0, every other
+thread gets the next lane on first use — so the pipeline prefetcher's
+``prefetch_realize``/``prefetch_stage`` spans render as their own
+Perfetto track instead of interleaving with the dispatch spans on one
+line. ``register_lane(name)`` additionally emits a Chrome-trace
+``thread_name`` metadata event so the track is labeled. ``wrap_iter``
+still times the CONSUMING thread's ``next()`` — with a threaded producer
+that is honestly the consumer's wait (stall), while the producer's own
+work now shows on its lane; pre-v5 dumps conflated the two on tid 0.
+Recording is thread-safe (lock-guarded lane map; deque appends are
+atomic); spans from a worker thread should pass ``step=`` explicitly —
+the shared round clock belongs to the consuming thread.
+
 Format: ``{"schema_version", "kind": "spans", "displayTimeUnit",
 "traceEvents": [{"name", "ph": "X", "ts", "dur", "pid", "tid",
-"args": {"step", "fenced"}}]}`` — ts/dur in microseconds since the
-recorder was constructed (Chrome trace convention). Validated by
-scripts/check_telemetry_schema.py (schema v3).
+"args": {"step", "fenced"}} | {"name": "thread_name", "ph": "M", "pid",
+"tid", "args": {"name"}}]}`` — ts/dur in microseconds since the recorder
+was constructed (Chrome trace convention). Validated by
+scripts/check_telemetry_schema.py (schema v3; "M" thread-name metadata
+events since v5).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -78,6 +95,35 @@ class PhaseSpans:
         self.events: deque = deque(maxlen=MAX_EVENTS)
         self._first_step: Optional[int] = None
         self._dumped: Optional[str] = None
+        # thread -> lane map (the constructing thread is lane 0): spans
+        # from other threads (the pipeline prefetch worker) get their own
+        # Perfetto track instead of interleaving with dispatch spans
+        self._lanes = {threading.get_ident(): 0}
+        self._lane_lock = threading.Lock()
+        # lane-label metadata lives OUTSIDE the bounded ring: a long run's
+        # span events must not evict the thread_name records (one per
+        # lane, emitted once) or the dumped tracks render unlabeled
+        self._meta_events = []
+
+    def _lane(self) -> int:
+        ident = threading.get_ident()
+        lane = self._lanes.get(ident)
+        if lane is None:
+            with self._lane_lock:
+                lane = self._lanes.setdefault(ident, len(self._lanes))
+        return lane
+
+    def register_lane(self, name: str) -> int:
+        """Name the CALLING thread's track (a Chrome-trace ``thread_name``
+        metadata event; schema v5) and return its lane id. Worker threads
+        (the pipeline prefetcher) call this once at startup."""
+        lane = self._lane()
+        if self.enabled:
+            self._meta_events.append({
+                "name": "thread_name", "ph": "M", "pid": 0, "tid": lane,
+                "args": {"name": name},
+            })
+        return lane
 
     # -- round clock -------------------------------------------------------
     def step(self, step_idx: int) -> None:
@@ -101,13 +147,16 @@ class PhaseSpans:
 
     # -- recording ---------------------------------------------------------
     @contextmanager
-    def span(self, name: str, fence=None):
+    def span(self, name: str, fence=None, step: Optional[int] = None):
         """Record one phase. Yields a handle whose ``fence(x)`` arms a
         scalar-fetch sync on ``x`` before the span closes (for targets only
         known inside the block, e.g. the dispatched round's metrics);
         ``fence=`` arms it up front. The sync only actually runs inside the
         steady-state window, so per-round overhead outside it stays at two
-        perf_counter calls. Yields None when the recorder is disabled."""
+        perf_counter calls. ``step=`` stamps the event with an explicit
+        round index — worker-thread spans (the prefetch lane) pass the
+        round they are REALIZING; the shared ``step()`` clock belongs to
+        the consuming thread. Yields None when the recorder is disabled."""
         if not self.enabled:
             yield None
             return
@@ -130,13 +179,18 @@ class PhaseSpans:
                 "ts": (t0 - self._t0) * 1e6,
                 "dur": (t1 - t0) * 1e6,
                 "pid": 0,
-                "tid": 0,
-                "args": {"step": self._step, "fenced": fenced},
+                "tid": self._lane(),
+                "args": {"step": self._step if step is None else int(step),
+                         "fenced": fenced},
             })
 
     def wrap_iter(self, it, name: str = "data_load"):
         """Yield from ``it``, recording each ``next()`` as one span (the
-        data-load/prefetch-wait phase). Transparent when disabled."""
+        data-load/prefetch-wait phase). With a threaded producer this
+        charges only the CONSUMING thread's wait to this span — which is
+        the honest reading; the producer's own work lands on its own lane
+        (``register_lane``) instead of being conflated into this track.
+        Transparent when disabled."""
         if not self.enabled:
             yield from it
             return
@@ -165,7 +219,7 @@ class PhaseSpans:
             "kind": "spans",
             "displayTimeUnit": "ms",
             "window": [self.start, self.stop_at],
-            "traceEvents": list(self.events),
+            "traceEvents": self._meta_events + list(self.events),
         }
         with open(path, "w") as f:
             json.dump(jsonable_tree(payload), f, allow_nan=False)

@@ -32,13 +32,10 @@ host-side [d_eff] sign vector and is CV-scale-only for poly4.
 
 from __future__ import annotations
 
-import time
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from commefficient_tpu.data import (
     FedSampler,
@@ -47,7 +44,6 @@ from commefficient_tpu.data import (
     load_fed_cifar100,
     load_fed_emnist,
     load_fed_imagenet,
-    prefetch,
 )
 from commefficient_tpu.models import ResNet9, classification_loss, fixup_resnet50
 from commefficient_tpu.parallel import FederatedSession
@@ -55,11 +51,9 @@ from commefficient_tpu.utils import (
     Config,
     MetricsWriter,
     TableLogger,
-    Timer,
     parse_args,
-    piecewise_linear_lr,
 )
-from commefficient_tpu.utils.logging import drain_round_metrics, make_logdir
+from commefficient_tpu.utils.logging import make_logdir
 
 
 def build_model_and_data(cfg: Config):
@@ -153,195 +147,71 @@ def build_session_and_sampler(cfg: Config, train, params, loss_fn, augment):
     return session, sampler
 
 
+class _CvHooks:
+    """The CV workload's plug-ins for the shared runner (train/runner.py):
+    loss/accuracy accumulation, the classification eval, the legacy
+    console row. See runner.WorkloadHooks for the contract."""
+
+    def __init__(self, session, test_ds, eval_batch_size):
+        self.session = session
+        self.test_ds = test_ds
+        self.eval_batch_size = eval_batch_size
+
+    def new_accumulator(self):
+        return {"loss": 0.0, "correct": 0.0, "count": 0.0}
+
+    def accumulate(self, acc, loss, metrics):
+        acc["loss"] += loss
+        acc["correct"] += float(metrics.get("correct", 0.0))
+        acc["count"] += float(metrics.get("count", 0.0))
+
+    def evaluate(self):
+        return self.session.evaluate(
+            self.test_ds.eval_batches(self.eval_batch_size)
+        )
+
+    def epoch_row(self, *, epoch, lr, acc, val, train_time, val_time,
+                  steps_per_epoch):
+        return {
+            "epoch": epoch + 1,
+            "lr": lr,
+            "train_loss": acc["loss"] / steps_per_epoch,
+            "train_acc": acc["correct"] / max(acc["count"], 1.0),
+            "val_loss": val["loss"],
+            "val_acc": val.get("accuracy", float("nan")),
+            "train_time": train_time,
+            "val_time": val_time,
+        }
+
+    def write_val(self, writer, val, step):
+        writer.scalar("val/loss", val["loss"], step)
+        writer.scalar("val/acc", val.get("accuracy", 0.0), step)
+
+    def on_epoch_end(self, epoch, val):
+        pass
+
+
 def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                test_ds, writer: Optional[MetricsWriter] = None,
                table: Optional[TableLogger] = None, eval_batch_size: int = 512,
                checkpointer=None):
     """The epoch loop (cv_train.py ~L120-240). Returns final val metrics.
 
-    With ``checkpointer`` (utils.checkpoint.FedCheckpointer) the loop honors
-    ``cfg.checkpoint_every``/``cfg.resume``: a resumed run fast-forwards to
-    the checkpointed round (sampler + lr schedule are pure functions of the
-    step, so this reproduces the uninterrupted run exactly — including the
-    fedsim environment's availability/chaos realization, which keys off the
-    same round clock)."""
-    steps_per_epoch = sampler.steps_per_epoch()
-    if session.fedsim_env is not None:
-        # chaos round indices can only be checked against the run length
-        # here — Config cannot know steps_per_epoch (it derives from the
-        # dataset size)
-        session.fedsim_env.validate_rounds(steps_per_epoch * cfg.num_epochs)
-        print(session.fedsim_env.describe())
-    lr_fn = partial(
-        piecewise_linear_lr,
-        steps_per_epoch=steps_per_epoch,
-        pivot_epoch=cfg.pivot_epoch,
-        num_epochs=cfg.num_epochs,
-        lr_scale=cfg.lr_scale,
-    )
-    table = table or TableLogger()
-    timer = Timer()
-    from commefficient_tpu.telemetry import (
-        DivergenceError,
-        build_perf_observability,
-        build_telemetry_riders,
-        record_crash,
-    )
-    from commefficient_tpu.utils.profiling import StepProfiler
+    Since the pipelined-execution PR this is a thin adapter over the
+    shared runner (train/runner.py), which owns the deferred-drain/
+    checkpoint/crash scaffold and the ``--pipeline_depth`` round-source
+    selection; only the CV-specific pieces (accuracy accumulation, eval,
+    the console row) live here. Checkpoint/resume semantics are the
+    runner's: a resumed run fast-forwards to the checkpointed round
+    (sampler + lr schedule + fedsim environment are pure functions of the
+    step, so this reproduces the uninterrupted run exactly)."""
+    from commefficient_tpu.train.runner import run_train_loop
 
-    profiler = StepProfiler(cfg.profile_dir)
-    # adaptive-communication controller (control/): None unless the config
-    # turns the control plane on. Built BEFORE the telemetry riders (the
-    # ledger switches to per-rung accounting, the flight recorder carries
-    # the controller snapshot) and BEFORE any restore (a resumed rung
-    # sequence needs the controller attached); prewarm AOT-traces every
-    # rung's round program for the run's real round-0 signature, so a
-    # mid-run rung switch can never be a silent retrace.
-    from commefficient_tpu.control import build_controller
-
-    controller = build_controller(
-        cfg, session, num_rounds=steps_per_epoch * cfg.num_epochs
-    )
-    if controller is not None:
-        controller.prewarm(sampler, float(lr_fn(0)))
-        print(controller.describe())
-    # telemetry riders (level >= 1): the comm ledger sources the SAME
-    # bytes_per_round accounting the session prints at startup; the flight
-    # recorder dumps flight_<step>.json + raises DivergenceError on a
-    # non-finite round (see telemetry/ package docstring)
-    ledger, flight = build_telemetry_riders(cfg, session, writer)
-    # perf observability (level >= 1): host phase spans + the compiled-
-    # round XLA audit -> perf_report.json + xla/* scalars (the audit's
-    # AOT trace doubles as the round's first compile-cache fill)
-    spans, _ = build_perf_observability(
-        cfg, session, sampler, writer, float(lr_fn(0)),
+    return run_train_loop(
+        cfg, session, sampler, _CvHooks(session, test_ds, eval_batch_size),
+        writer=writer, table=table, checkpointer=checkpointer,
         generated_by="train/cv_train",
     )
-    val = {}
-    step = 0
-    # the current epoch's drain closure, reachable from the crash handler:
-    # a BudgetExhaustedError (or any mid-epoch crash) fires BEFORE the
-    # deferred epoch-end drain, so without this flush the ledger/flight
-    # would be blind to the crashed epoch's completed rounds
-    live_drain = [None]
-    if checkpointer is not None and cfg.resume:
-        restored = checkpointer.restore(session)
-        if restored is not None:
-            step = restored
-            profiler.resume_at(step)  # clamp the trace window post-resume
-            if spans is not None:
-                spans.resume_at(step)
-            print(f"resumed from checkpoint at round {step}")
-    try:
-        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
-            timer()
-            pending = []  # (step, lr, device-metrics); see drain_round_metrics
-            train_loss, train_correct, train_count = 0.0, 0.0, 0.0
-
-            def acc(loss, metrics):
-                nonlocal train_loss, train_correct, train_count
-                train_loss += loss
-                train_correct += float(metrics.get("correct", 0.0))
-                train_count += float(metrics.get("count", 0.0))
-
-            def drain():
-                if spans is not None:
-                    with spans.span("metric_drain"):
-                        drain_round_metrics(pending, writer, acc,
-                                            ledger=ledger, flight=flight,
-                                            controller=controller)
-                else:
-                    drain_round_metrics(pending, writer, acc,
-                                        ledger=ledger, flight=flight,
-                                        controller=controller)
-
-            live_drain[0] = drain
-            use_idx = getattr(session, "_dev_data", None) is not None
-            rounds = (
-                prefetch(sampler.epoch_indices(epoch))
-                if use_idx
-                else prefetch(sampler.epoch(epoch))
-            )
-            if spans is not None:
-                # times each next() — the data-load/prefetch-wait phase
-                rounds = spans.wrap_iter(rounds, "data_load")
-            for round_idx, item in enumerate(rounds):
-                if epoch * steps_per_epoch + round_idx < step:
-                    continue  # fast-forward within the resumed epoch
-                lr = float(lr_fn(step))
-                profiler.step(step)
-                if spans is not None:
-                    spans.step(step)
-                if use_idx:
-                    client_ids, idx, plan = item
-                    metrics = session.train_round_indices(client_ids, idx, plan, lr)
-                else:
-                    client_ids, batch = item
-                    L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
-                    if L:
-                        batch = {
-                            k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                            for k, v in batch.items()
-                        }
-                    metrics = session.train_round(client_ids, batch, lr)
-                pending.append((step, lr, metrics))
-                step += 1
-                if checkpointer is not None:
-                    if checkpointer.will_save(step):
-                        drain()
-                    if spans is not None:
-                        with spans.span("checkpoint"):
-                            checkpointer.maybe_save(session, step)
-                    else:
-                        checkpointer.maybe_save(session, step)
-            drain()
-            train_time = timer()
-            val = session.evaluate(test_ds.eval_batches(eval_batch_size))
-            val_time = timer()
-            row = {
-                "epoch": epoch + 1,
-                "lr": lr,
-                "train_loss": train_loss / steps_per_epoch,
-                "train_acc": train_correct / max(train_count, 1.0),
-                "val_loss": val["loss"],
-                "val_acc": val.get("accuracy", float("nan")),
-                "train_time": train_time,
-                "val_time": val_time,
-            }
-            table.append(row)
-            if writer:
-                writer.scalar("val/loss", val["loss"], step)
-                writer.scalar("val/acc", val.get("accuracy", 0.0), step)
-                writer.flush()
-    except Exception as e:
-        # best-effort flush of the crashed epoch's completed rounds so the
-        # ledger totals and the flight ring cover them (a flush-time
-        # DivergenceError supersedes: it names the true first bad round)
-        if live_drain[0] is not None and not isinstance(
-                e, DivergenceError):
-            try:
-                live_drain[0]()
-            except DivergenceError:
-                raise
-            except Exception:  # noqa: BLE001 — the original error wins
-                pass
-        # divergence already dumped its own flight record in the drain;
-        # any OTHER crash dumps the recent trajectory for the post-mortem
-        record_crash(flight, e)
-        raise
-    finally:
-        profiler.close()
-        if spans is not None:
-            session.spans = None
-            spans.close()  # dumps spans_<step>.json (crash included)
-        if ledger is not None:
-            # partial ledgers are still evidence — write on crash too
-            ledger.write(writer.logdir)
-    if not val:
-        # resumed at/after the final round (the epoch loop never ran):
-        # still evaluate so callers get final metrics instead of a KeyError
-        val = session.evaluate(test_ds.eval_batches(eval_batch_size))
-    return val
 
 
 def main(argv=None, **overrides):

@@ -48,14 +48,13 @@ local-error/local-momentum configs — per-client state stays in host RAM
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from commefficient_tpu.data import FedSampler, load_fed_personachat, prefetch
+from commefficient_tpu.data import FedSampler, load_fed_personachat
 from commefficient_tpu.models import (
     GPT2Config,
     GPT2DoubleHeads,
@@ -68,11 +67,9 @@ from commefficient_tpu.utils import (
     Config,
     MetricsWriter,
     TableLogger,
-    Timer,
     parse_args,
-    piecewise_linear_lr,
 )
-from commefficient_tpu.utils.logging import drain_round_metrics, make_logdir
+from commefficient_tpu.utils.logging import make_logdir
 
 
 def build_model_and_data(cfg: Config):
@@ -125,195 +122,82 @@ def build_model_and_data(cfg: Config):
     return train, test, real, loaded, gcfg, model, params, loss_fn
 
 
+class _Gpt2Hooks:
+    """The NLP workload's plug-ins for the shared runner (train/runner.py):
+    lm/mc loss accumulation, the nll->ppl eval, the legacy console row,
+    and the per-epoch sample generation. See runner.WorkloadHooks."""
+
+    def __init__(self, cfg, session, test_ds, eval_batch_size, gcfg):
+        self.cfg = cfg
+        self.session = session
+        self.test_ds = test_ds
+        self.eval_batch_size = eval_batch_size
+        self.gcfg = gcfg
+
+    def new_accumulator(self):
+        return {"loss": 0.0, "lm": 0.0, "mc": 0.0}
+
+    def accumulate(self, acc, loss, metrics):
+        W = self.cfg.num_workers
+        acc["loss"] += loss
+        # lm/mc aux are psum'd sums of per-client means -> / W
+        acc["lm"] += float(metrics.get("lm_loss", 0.0)) / W
+        acc["mc"] += float(metrics.get("mc_loss", 0.0)) / W
+
+    def evaluate(self):
+        return evaluate_ppl(self.session, self.test_ds, self.eval_batch_size)
+
+    def epoch_row(self, *, epoch, lr, acc, val, train_time, val_time,
+                  steps_per_epoch):
+        return {
+            "epoch": epoch + 1,
+            "lr": lr,
+            "train_loss": acc["loss"] / steps_per_epoch,
+            "train_lm": acc["lm"] / steps_per_epoch,
+            "train_mc": acc["mc"] / steps_per_epoch,
+            "val_nll": val["nll"],
+            "val_ppl": val["ppl"],
+            "val_mc_acc": val["mc_accuracy"],
+            "train_time": train_time,
+            "val_time": val_time,
+        }
+
+    def write_val(self, writer, val, step):
+        writer.scalar("val/nll", val["nll"], step)
+        writer.scalar("val/ppl", val["ppl"], step)
+        writer.scalar("val/mc_acc", val["mc_accuracy"], step)
+
+    def on_epoch_end(self, epoch, val):
+        if self.gcfg is None:
+            return
+        # periodic generation (reference gpt2_train eval ~L280-360)
+        from commefficient_tpu.data.personachat import SPECIAL_TOKENS
+
+        prompt, gen = sample_generation(
+            self.session, self.gcfg, self.test_ds,
+            base_vocab=self.gcfg.vocab_size - len(SPECIAL_TOKENS),
+        )
+        print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
+              f"-> {gen.tolist()}")
+
+
 def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
                test_ds, writer: Optional[MetricsWriter] = None,
                table: Optional[TableLogger] = None, eval_batch_size: int = 8,
                checkpointer=None, gcfg=None):
     """Epoch loop with the reference's eval: nll -> ppl + MC accuracy
-    (gpt2_train.py ~L280-360). Honors checkpoint_every/resume like
+    (gpt2_train.py ~L280-360). A thin adapter over the shared runner
+    (train/runner.py — same scaffold and ``--pipeline_depth`` round-source
+    selection as cv_train); honors checkpoint_every/resume like
     cv_train.train_loop."""
-    steps_per_epoch = sampler.steps_per_epoch()
-    if session.fedsim_env is not None:
-        # chaos round indices can only be checked against the run length
-        # here — Config cannot know steps_per_epoch (it derives from the
-        # dataset size)
-        session.fedsim_env.validate_rounds(steps_per_epoch * cfg.num_epochs)
-        print(session.fedsim_env.describe())
-    lr_fn = partial(
-        piecewise_linear_lr,
-        steps_per_epoch=steps_per_epoch,
-        pivot_epoch=cfg.pivot_epoch,
-        num_epochs=cfg.num_epochs,
-        lr_scale=cfg.lr_scale,
-    )
-    table = table or TableLogger()
-    timer = Timer()
-    from commefficient_tpu.telemetry import (
-        DivergenceError,
-        build_perf_observability,
-        build_telemetry_riders,
-        record_crash,
-    )
-    from commefficient_tpu.utils.profiling import StepProfiler
+    from commefficient_tpu.train.runner import run_train_loop
 
-    profiler = StepProfiler(cfg.profile_dir)
-    # adaptive-communication controller (control/), same wiring as
-    # cv_train: built before the riders (per-rung ledger accounting,
-    # flight snapshot) and before any restore; prewarm AOT-traces every
-    # rung so a mid-run switch can never be a silent retrace — at GPT-2
-    # scale that is ONE extra trace per rung, not an extra XLA compile.
-    from commefficient_tpu.control import build_controller
-
-    controller = build_controller(
-        cfg, session, num_rounds=steps_per_epoch * cfg.num_epochs
-    )
-    if controller is not None:
-        controller.prewarm(sampler, float(lr_fn(0)))
-        print(controller.describe())
-    # telemetry riders (level >= 1), shared constructor with cv_train
-    ledger, flight = build_telemetry_riders(cfg, session, writer)
-    # perf observability (level >= 1), shared constructor with cv_train:
-    # phase spans + compiled-round audit -> perf_report.json. NB the audit
-    # AOT-compiles the round once more — at GPT-2 scale pass
-    # --perf_audit false if that extra compile is unacceptable.
-    spans, _ = build_perf_observability(
-        cfg, session, sampler, writer, float(lr_fn(0)),
+    return run_train_loop(
+        cfg, session, sampler,
+        _Gpt2Hooks(cfg, session, test_ds, eval_batch_size, gcfg),
+        writer=writer, table=table, checkpointer=checkpointer,
         generated_by="train/gpt2_train",
     )
-    val = {}
-    step = 0
-    W = cfg.num_workers
-    # crash-reachable drain closure — see cv_train.train_loop (a mid-epoch
-    # BudgetExhaustedError/crash fires before the deferred drain)
-    live_drain = [None]
-    if checkpointer is not None and cfg.resume:
-        restored = checkpointer.restore(session)
-        if restored is not None:
-            step = restored
-            profiler.resume_at(step)  # clamp the trace window post-resume
-            if spans is not None:
-                spans.resume_at(step)
-            print(f"resumed from checkpoint at round {step}")
-    try:
-        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
-            timer()
-            pending = []  # (step, lr, device-metrics); see drain_round_metrics
-            tr_loss = tr_lm = tr_mc = 0.0
-
-            def acc(loss, metrics):
-                nonlocal tr_loss, tr_lm, tr_mc
-                tr_loss += loss
-                # lm/mc aux are psum'd sums of per-client means -> / W
-                tr_lm += float(metrics.get("lm_loss", 0.0)) / W
-                tr_mc += float(metrics.get("mc_loss", 0.0)) / W
-
-            def drain():
-                if spans is not None:
-                    with spans.span("metric_drain"):
-                        drain_round_metrics(pending, writer, acc,
-                                            ledger=ledger, flight=flight,
-                                            controller=controller)
-                else:
-                    drain_round_metrics(pending, writer, acc,
-                                        ledger=ledger, flight=flight,
-                                        controller=controller)
-
-            live_drain[0] = drain
-            use_idx = getattr(session, "_dev_data", None) is not None
-            rounds = (
-                prefetch(sampler.epoch_indices(epoch))
-                if use_idx
-                else prefetch(sampler.epoch(epoch))
-            )
-            if spans is not None:
-                # times each next() — the data-load/prefetch-wait phase
-                rounds = spans.wrap_iter(rounds, "data_load")
-            for round_idx, item in enumerate(rounds):
-                if epoch * steps_per_epoch + round_idx < step:
-                    continue  # fast-forward within the resumed epoch
-                lr = float(lr_fn(step))
-                profiler.step(step)
-                if spans is not None:
-                    spans.step(step)
-                if use_idx:
-                    client_ids, idx, plan = item
-                    metrics = session.train_round_indices(client_ids, idx, plan, lr)
-                else:
-                    client_ids, batch = item
-                    L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
-                    if L:
-                        batch = {
-                            k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
-                            for k, v in batch.items()
-                        }
-                    metrics = session.train_round(client_ids, batch, lr)
-                pending.append((step, lr, metrics))
-                step += 1
-                if checkpointer is not None:
-                    if checkpointer.will_save(step):
-                        drain()
-                    if spans is not None:
-                        with spans.span("checkpoint"):
-                            checkpointer.maybe_save(session, step)
-                    else:
-                        checkpointer.maybe_save(session, step)
-            drain()
-            train_time = timer()
-            val = evaluate_ppl(session, test_ds, eval_batch_size)
-            val_time = timer()
-            row = {
-                "epoch": epoch + 1,
-                "lr": lr,
-                "train_loss": tr_loss / steps_per_epoch,
-                "train_lm": tr_lm / steps_per_epoch,
-                "train_mc": tr_mc / steps_per_epoch,
-                "val_nll": val["nll"],
-                "val_ppl": val["ppl"],
-                "val_mc_acc": val["mc_accuracy"],
-                "train_time": train_time,
-                "val_time": val_time,
-            }
-            table.append(row)
-            if writer:
-                writer.scalar("val/nll", val["nll"], step)
-                writer.scalar("val/ppl", val["ppl"], step)
-                writer.scalar("val/mc_acc", val["mc_accuracy"], step)
-                writer.flush()
-            if gcfg is not None:
-                # periodic generation (reference gpt2_train eval ~L280-360)
-                from commefficient_tpu.data.personachat import SPECIAL_TOKENS
-
-                prompt, gen = sample_generation(
-                    session, gcfg, test_ds,
-                    base_vocab=gcfg.vocab_size - len(SPECIAL_TOKENS),
-                )
-                print(f"  sample (epoch {epoch + 1}): ...{prompt[-8:].tolist()} "
-                      f"-> {gen.tolist()}")
-    except Exception as e:
-        # best-effort flush of the crashed epoch's completed rounds (see
-        # cv_train.train_loop; a flush-time DivergenceError supersedes)
-        if live_drain[0] is not None and not isinstance(
-                e, DivergenceError):
-            try:
-                live_drain[0]()
-            except DivergenceError:
-                raise
-            except Exception:  # noqa: BLE001 — the original error wins
-                pass
-        record_crash(flight, e)
-        raise
-    finally:
-        profiler.close()
-        if spans is not None:
-            session.spans = None
-            spans.close()  # dumps spans_<step>.json (crash included)
-        if ledger is not None:
-            ledger.write(writer.logdir)
-    if not val:
-        # resumed at/after the final round (the epoch loop never ran):
-        # still evaluate so callers get final metrics instead of a KeyError
-        val = evaluate_ppl(session, test_ds, eval_batch_size)
-    return val
 
 
 def sample_generation(session: FederatedSession, gcfg, test_ds, base_vocab: int,

@@ -1,0 +1,274 @@
+"""The shared train-loop runner — one epoch/drain/crash scaffold for both
+workload entries.
+
+``cv_train.train_loop`` and ``gpt2_train.train_loop`` used to carry
+near-identical copies of the round loop: the deferred-drain buffer and its
+``live_drain`` crash-flush closure, checkpoint ``will_save``-then-drain
+ordering, ``DivergenceError`` surfacing, the telemetry-rider/controller/
+perf-observability construction order, and the resume fast-forward. The
+pipelined round engine (pipeline/) would have had to be wired TWICE into
+that duplication — so the scaffold now lives here once, and each entry
+supplies only its workload-specific pieces through ``WorkloadHooks``
+(accumulation, eval, the console row, the optional per-epoch hook).
+
+Round-source selection is the ONE place ``cfg.pipeline_depth`` is read:
+depth 0 runs ``_sync_epoch_rounds`` — the legacy synchronous loop, moved
+here verbatim (nothing pipeline-related constructed; golden parity and
+level-0 HLO untouched) — while depth >= 1 builds a
+``pipeline.PipelinedRounds`` engine whose prefetcher overlaps round
+t+1..t+depth's host work and H2D with round t's device compute. Both
+sources yield the same ``(step, lr, metrics)`` triples to the same drain/
+checkpoint/crash machinery, which is what makes the two execution modes
+bit-exact (tests/test_pipeline.py pins it end to end).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+from commefficient_tpu.data import prefetch
+from commefficient_tpu.utils import TableLogger, Timer, piecewise_linear_lr
+from commefficient_tpu.utils.logging import drain_round_metrics
+
+
+class WorkloadHooks:
+    """What a workload entry plugs into the shared runner. Subclasses
+    override everything except ``on_epoch_end`` (optional)."""
+
+    def new_accumulator(self):
+        """Fresh per-epoch accumulation state (any mutable object)."""
+        raise NotImplementedError
+
+    def accumulate(self, acc, loss, metrics) -> None:
+        """Fold one drained round into ``acc`` (drain order == step
+        order)."""
+        raise NotImplementedError
+
+    def evaluate(self) -> dict:
+        """End-of-epoch validation metrics (also the final-eval fallback
+        when a resume lands at/after the last round)."""
+        raise NotImplementedError
+
+    def epoch_row(self, *, epoch, lr, acc, val, train_time, val_time,
+                  steps_per_epoch) -> dict:
+        """The console TableLogger row for one epoch."""
+        raise NotImplementedError
+
+    def write_val(self, writer, val, step) -> None:
+        """Write the epoch's val/* scalars."""
+        raise NotImplementedError
+
+    def on_epoch_end(self, epoch, val) -> None:
+        """Optional per-epoch side effect (gpt2's sample generation)."""
+
+
+def _sync_epoch_rounds(cfg, session, sampler, lr_fn, spans, profiler,
+                       epoch, start_step, steps_per_epoch):
+    """The legacy synchronous round source (pipeline_depth 0): assemble,
+    stage and dispatch each round on the critical path, exactly the
+    pre-runner train-loop body. Yields ``(step, lr, metrics)``."""
+    use_idx = getattr(session, "_dev_data", None) is not None
+    rounds = (
+        prefetch(sampler.epoch_indices(epoch))
+        if use_idx
+        else prefetch(sampler.epoch(epoch))
+    )
+    if spans is not None:
+        # times each next() — the data-load/prefetch-wait phase
+        rounds = spans.wrap_iter(rounds, "data_load")
+    for round_idx, item in enumerate(rounds):
+        s = epoch * steps_per_epoch + round_idx
+        if s < start_step:
+            continue  # fast-forward within the resumed epoch
+        lr = float(lr_fn(s))
+        profiler.step(s)
+        if spans is not None:
+            spans.step(s)
+        if use_idx:
+            client_ids, idx, plan = item
+            metrics = session.train_round_indices(client_ids, idx, plan, lr)
+        else:
+            client_ids, batch = item
+            L = cfg.round_microbatches  # fedavg [W, L, B/L, ...]
+            if L:
+                batch = {
+                    k: v.reshape(v.shape[0], L, v.shape[1] // L,
+                                 *v.shape[2:])
+                    for k, v in batch.items()
+                }
+            metrics = session.train_round(client_ids, batch, lr)
+        yield s, lr, metrics
+
+
+def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
+                   writer=None, table: Optional[TableLogger] = None,
+                   checkpointer=None, generated_by: str = "train"):
+    """The epoch loop shared by both entries. Returns final val metrics.
+
+    With ``checkpointer`` (utils.checkpoint.FedCheckpointer) the loop
+    honors ``cfg.checkpoint_every``/``cfg.resume``: a resumed run
+    fast-forwards to the checkpointed round (sampler, lr schedule and the
+    fedsim environment are pure functions of the step, so this reproduces
+    the uninterrupted run exactly — at any pipeline depth)."""
+    steps_per_epoch = sampler.steps_per_epoch()
+    num_rounds = steps_per_epoch * cfg.num_epochs
+    if session.fedsim_env is not None:
+        # chaos round indices can only be checked against the run length
+        # here — Config cannot know steps_per_epoch (it derives from the
+        # dataset size)
+        session.fedsim_env.validate_rounds(num_rounds)
+        print(session.fedsim_env.describe())
+    lr_fn = partial(
+        piecewise_linear_lr,
+        steps_per_epoch=steps_per_epoch,
+        pivot_epoch=cfg.pivot_epoch,
+        num_epochs=cfg.num_epochs,
+        lr_scale=cfg.lr_scale,
+    )
+    table = table or TableLogger()
+    timer = Timer()
+    from commefficient_tpu.telemetry import (
+        DivergenceError,
+        build_perf_observability,
+        build_telemetry_riders,
+        record_crash,
+    )
+    from commefficient_tpu.utils.profiling import StepProfiler
+
+    profiler = StepProfiler(cfg.profile_dir)
+    # adaptive-communication controller (control/): None unless the config
+    # turns the control plane on. Built BEFORE the telemetry riders (the
+    # ledger switches to per-rung accounting, the flight recorder carries
+    # the controller snapshot) and BEFORE any restore (a resumed rung
+    # sequence needs the controller attached); prewarm AOT-traces every
+    # rung's round program for the run's real round-0 signature, so a
+    # mid-run rung switch can never be a silent retrace.
+    from commefficient_tpu.control import build_controller
+
+    controller = build_controller(cfg, session, num_rounds=num_rounds)
+    if controller is not None:
+        controller.prewarm(sampler, float(lr_fn(0)))
+        print(controller.describe())
+    # telemetry riders (level >= 1): comm ledger + flight recorder
+    ledger, flight = build_telemetry_riders(cfg, session, writer)
+    # perf observability (level >= 1): host phase spans + the compiled-
+    # round XLA audit -> perf_report.json + xla/* scalars
+    spans, _ = build_perf_observability(
+        cfg, session, sampler, writer, float(lr_fn(0)),
+        generated_by=generated_by,
+    )
+    val = {}
+    step = 0
+    # the current epoch's drain closure, reachable from the crash handler:
+    # a BudgetExhaustedError, a prefetch-worker fault, or any mid-epoch
+    # crash fires BEFORE the deferred epoch-end drain, so without this
+    # flush the ledger/flight would be blind to the crashed epoch's
+    # completed rounds
+    live_drain = [None]
+    if checkpointer is not None and cfg.resume:
+        restored = checkpointer.restore(session)
+        if restored is not None:
+            step = restored
+            profiler.resume_at(step)  # clamp the trace window post-resume
+            if spans is not None:
+                spans.resume_at(step)
+            print(f"resumed from checkpoint at round {step}")
+    # pipelined round engine (pipeline/): ONLY built at depth >= 1 — the
+    # one place both entries' pipelining is wired. Constructed AFTER the
+    # restore so the prefetcher starts at the resumed step (its inputs
+    # are pure functions of the round index, so the staged stream is the
+    # uninterrupted run's).
+    engine = None
+    if cfg.pipeline_enabled:
+        from commefficient_tpu.pipeline import PipelinedRounds
+
+        engine = PipelinedRounds(
+            cfg, session, sampler, lr_fn, num_rounds,
+            steps_per_epoch=steps_per_epoch, spans=spans, profiler=profiler,
+        ).start(step)
+        print(f"pipeline: depth={cfg.pipeline_depth} (host staging + H2D "
+              "overlap device compute; bit-exact vs depth 0)")
+    try:
+        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
+            timer()
+            pending = []  # (step, lr, device-metrics); drain_round_metrics
+            acc_state = hooks.new_accumulator()
+
+            def acc(loss, metrics, _a=acc_state):
+                hooks.accumulate(_a, loss, metrics)
+
+            def drain(_acc=acc):
+                if spans is not None:
+                    with spans.span("metric_drain"):
+                        drain_round_metrics(pending, writer, _acc,
+                                            ledger=ledger, flight=flight,
+                                            controller=controller)
+                else:
+                    drain_round_metrics(pending, writer, _acc,
+                                        ledger=ledger, flight=flight,
+                                        controller=controller)
+
+            live_drain[0] = drain
+            rounds = (
+                engine.epoch_rounds(epoch, step)
+                if engine is not None
+                else _sync_epoch_rounds(cfg, session, sampler, lr_fn, spans,
+                                        profiler, epoch, step,
+                                        steps_per_epoch)
+            )
+            lr = float(lr_fn(step))
+            for s, lr, metrics in rounds:
+                pending.append((s, lr, metrics))
+                step = s + 1
+                if checkpointer is not None:
+                    if checkpointer.will_save(step):
+                        drain()
+                    if spans is not None:
+                        with spans.span("checkpoint"):
+                            checkpointer.maybe_save(session, step)
+                    else:
+                        checkpointer.maybe_save(session, step)
+            drain()
+            train_time = timer()
+            val = hooks.evaluate()
+            val_time = timer()
+            table.append(hooks.epoch_row(
+                epoch=epoch, lr=lr, acc=acc_state, val=val,
+                train_time=train_time, val_time=val_time,
+                steps_per_epoch=steps_per_epoch,
+            ))
+            if writer:
+                hooks.write_val(writer, val, step)
+                writer.flush()
+            hooks.on_epoch_end(epoch, val)
+    except Exception as e:
+        # best-effort flush of the crashed epoch's completed rounds so the
+        # ledger totals and the flight ring cover them (a flush-time
+        # DivergenceError supersedes: it names the true first bad round)
+        if live_drain[0] is not None and not isinstance(e, DivergenceError):
+            try:
+                live_drain[0]()
+            except DivergenceError:
+                raise
+            except Exception:  # noqa: BLE001 — the original error wins
+                pass
+        # divergence already dumped its own flight record in the drain;
+        # any OTHER crash dumps the recent trajectory for the post-mortem
+        record_crash(flight, e)
+        raise
+    finally:
+        if engine is not None:
+            engine.close()  # join the prefetch worker (crash paths too)
+        profiler.close()
+        if spans is not None:
+            session.spans = None
+            spans.close()  # dumps spans_<step>.json (crash included)
+        if ledger is not None:
+            # partial ledgers are still evidence — write on crash too
+            ledger.write(writer.logdir)
+    if not val:
+        # resumed at/after the final round (the epoch loop never ran):
+        # still evaluate so callers get final metrics instead of a KeyError
+        val = hooks.evaluate()
+    return val

@@ -309,6 +309,22 @@ class Config:
     # time (Config cannot know steps_per_epoch).
     chaos: str = ""
 
+    # --- pipelined round execution (commefficient_tpu/pipeline/;
+    # TPU-native — the reference's host loop is fully serial) ---
+    # Rounds of host-side round work (non-IID sampler draw + batch
+    # assembly, fedsim environment realization, schedule lr, eager H2D
+    # staging onto the mesh) realized AHEAD of the device by a background
+    # worker thread, so round t+1's host serial time overlaps round t's
+    # device compute. 0 (default): fully synchronous — NOTHING
+    # pipeline-related is constructed and the round stays bit-identical
+    # to a pre-pipeline build (the telemetry_level-0 discipline; golden
+    # parity recordings pin it). Any depth is BIT-EXACT vs depth 0:
+    # every prefetched input is a pure function of (seed, stream,
+    # round_idx), controller decisions/drains keep their synchronous
+    # order, and checkpoint saves fence the window (README "Pipelined
+    # round execution" documents the determinism contract).
+    pipeline_depth: int = 0
+
     # --- adaptive communication budget (commefficient_tpu/control/;
     # TPU-native — the reference fixes k/num_cols/rank once per run) ---
     # Rung-selection policy: "none" (default — NOTHING control-related is
@@ -529,6 +545,11 @@ class Config:
                 f"beyond the first compile) or None (count only), got "
                 f"{self.max_retraces}"
             )
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0 (0 = synchronous), got "
+                f"{self.pipeline_depth}"
+            )
         self._validate_control()
 
     def _validate_control(self) -> None:
@@ -663,6 +684,14 @@ class Config:
         single-rung and bit-identical to a pre-control build — the golden
         parity recordings pin that (control/ package docstring)."""
         return self.control_policy != "none"
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        """True when the pipelined round engine must be built (pipeline/
+        package). False keeps the train loop on the legacy synchronous
+        path with nothing pipeline-related constructed — the
+        fedsim_enabled/control_enabled discipline."""
+        return self.pipeline_depth > 0
 
     @property
     def sampler_batch_size(self) -> int:

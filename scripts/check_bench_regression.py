@@ -28,14 +28,25 @@ Comparison rules:
     ``chip`` differs from the latest record's are EXCLUDED from the
     baseline — a v4 number is not a regression baseline for a v5e run.
     Records without a ``chip`` key (pre-provenance rounds) are kept.
-  * A metric new in the latest record, or with no comparable history,
-    passes with a note. No BENCH files or only one -> pass (nothing to
-    compare).
+  * A metric new in the latest record, or with no comparable history, is
+    UNGATED — but no longer silently: new metrics are counted in the exit
+    summary and the JSON summary line, and ``--max_new_metrics N`` turns
+    "more than N gated-direction metrics with no history" into exit 1. A
+    renamed metric looks exactly like a new one, so without the guard a
+    rename could dodge the gate forever (every round "new", never
+    compared); the driver passes the expected churn (usually 0 between
+    feature PRs).
+  * No BENCH files or only one -> pass (nothing to compare).
 
-Exit codes: 0 pass, 1 regression, 2 usage error. Wired into tier-1 by
-tests/test_bench_regression.py, which includes a detects-regression
-self-test on a synthetic BENCH pair (same pattern as
-scripts/check_mode_dispatch.py).
+The last stdout line is a machine-readable JSON summary:
+``{"kind": "bench_regression", "gated": N, "regressions": [...],
+"new_metrics": [...], "skipped_chip_records": K}`` — so the driver (and
+tests) consume the result without scraping the prose.
+
+Exit codes: 0 pass, 1 regression (or new-metric guard tripped), 2 usage
+error. Wired into tier-1 by tests/test_bench_regression.py, which
+includes a detects-regression self-test on a synthetic BENCH pair (same
+pattern as scripts/check_mode_dispatch.py).
 """
 
 from __future__ import annotations
@@ -57,9 +68,16 @@ TOLERANCES = {
     "mfu": 0.10,
 }
 
+# pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated
+# (throughput-ish; occupancy falling means the prefetcher stopped hiding
+# host time). Its *_host_stall_ms stays INFORMATIONAL on purpose: near-zero
+# stalls make relative tolerances meaningless (0.2 ms vs a 0.1 ms median
+# is +100% of noise), so the stall regression shows up through occupancy
+# and samples/s instead.
 LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
 HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
-HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed")
+HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
+                             "_samples_per_sec", "_occupancy")
 
 
 def metric_direction(name: str):
@@ -95,10 +113,13 @@ def tolerance_for(name: str, default: float) -> float:
 
 
 def check_regression(history, latest, default_tolerance=DEFAULT_TOLERANCE):
-    """(regressions, notes) comparing ``latest`` (metric dict) against
-    ``history`` (list of metric dicts, oldest first). Each regression is a
-    dict naming the metric, direction, latest value, baseline and bound."""
-    regressions, notes = [], []
+    """(regressions, new_metrics, notes) comparing ``latest`` (metric
+    dict) against ``history`` (list of metric dicts, oldest first). Each
+    regression is a dict naming the metric, direction, latest value,
+    baseline and bound; ``new_metrics`` lists the gated-direction metrics
+    that had NO comparable history (ungated this round — the
+    ``--max_new_metrics`` guard's input)."""
+    regressions, new_metrics, notes = [], [], []
     chip = latest.get("chip")
     comparable = []
     for h in history:
@@ -120,6 +141,7 @@ def check_regression(history, latest, default_tolerance=DEFAULT_TOLERANCE):
             and not isinstance(h.get(name), bool)
         ]
         if not prior:
+            new_metrics.append(name)
             notes.append(f"{name}: no comparable history (new metric?)")
             continue
         base = median(prior)
@@ -140,7 +162,7 @@ def check_regression(history, latest, default_tolerance=DEFAULT_TOLERANCE):
                 "tolerance": tol,
                 "n_prior": len(prior),
             })
-    return regressions, notes
+    return regressions, new_metrics, notes
 
 
 def main(argv=None) -> int:
@@ -156,33 +178,74 @@ def main(argv=None) -> int:
                     help="default relative noise tolerance "
                     f"(default {DEFAULT_TOLERANCE}; per-metric overrides "
                     "in TOLERANCES)")
-    args = ap.parse_args(argv)
-    if args.tolerance < 0:
-        print("tolerance must be >= 0")
+    ap.add_argument("--max_new_metrics", type=int, default=None,
+                    help="fail (exit 1) when MORE than this many "
+                    "gated-direction metrics have no comparable history — "
+                    "a renamed metric reads as 'new' every round and would "
+                    "otherwise dodge the gate forever (default: no limit; "
+                    "the driver passes the expected churn, usually 0)")
+    def summary_line(**kw):
+        # machine-readable result, ALWAYS the last stdout line on every
+        # exit path (the driver/tests consume this instead of scraping
+        # the prose)
+        print(json.dumps({"kind": "bench_regression", **kw}))
+
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        # argparse already printed usage to stderr; --help exits 0 and
+        # keeps argparse's behavior, but a bad/unknown flag must still
+        # honor the summary-line contract on stdout
+        if e.code in (0, None):
+            raise
+        summary_line(compared=False, gated=0, regressions=[],
+                     new_metrics=[], skipped_chip_records=0,
+                     error="argument parsing failed (see usage on stderr)")
         return 2
+
+    def usage_error(msg):
+        print(msg)
+        summary_line(compared=False, gated=0, regressions=[],
+                     new_metrics=[], skipped_chip_records=0, error=msg)
+        return 2
+
+    if args.tolerance < 0:
+        return usage_error("tolerance must be >= 0")
+    if args.max_new_metrics is not None and args.max_new_metrics < 0:
+        return usage_error("max_new_metrics must be >= 0")
+
     paths = sorted(glob.glob(os.path.join(args.dir, args.glob)))
     if len(paths) < 2:
         print(f"nothing to compare ({len(paths)} bench record(s) match "
               f"{args.glob!r} in {args.dir!r}) — pass")
+        summary_line(compared=False, gated=0, regressions=[],
+                     new_metrics=[], skipped_chip_records=0)
         return 0
     try:
         history = [load_bench(p) for p in paths[:-1]]
         latest = load_bench(paths[-1])
     except (ValueError, json.JSONDecodeError, OSError) as e:
+        # the summary-line contract holds on EVERY exit path — a consumer
+        # json-parsing the last line must not choke on the prose error
         print(f"unreadable bench record: {e}")
+        summary_line(compared=False, gated=0, regressions=[],
+                     new_metrics=[], skipped_chip_records=0,
+                     error=f"unreadable bench record: {e}")
         return 2
-    regressions, notes = check_regression(history, latest, args.tolerance)
+    regressions, new_metrics, notes = check_regression(
+        history, latest, args.tolerance
+    )
     for n in notes:
         print(f"note: {n}")
     gated = sorted(
         k for k in latest
         if metric_direction(k) and isinstance(latest[k], (int, float))
     )
+    n_skipped = len(notes) - len(new_metrics)  # chip-provenance skips
     print(f"latest: {paths[-1]} vs {len(paths) - 1} prior record(s); "
-          f"{len(gated)} gated metric(s)")
-    if not regressions:
-        print("OK — no metric regressed past its tolerance")
-        return 0
+          f"{len(gated)} gated metric(s), {len(new_metrics)} ungated as "
+          "new/no-history")
+    rc = 0
     for r in regressions:
         arrow = "fell below" if r["direction"] == "up" else "rose above"
         print(
@@ -190,7 +253,23 @@ def main(argv=None) -> int:
             f"{r['bound']:g} (median of {r['n_prior']} prior: "
             f"{r['baseline_median']:g}, tolerance {r['tolerance']:.0%})"
         )
-    return 1
+        rc = 1
+    if (args.max_new_metrics is not None
+            and len(new_metrics) > args.max_new_metrics):
+        print(
+            f"NEW-METRIC GUARD: {len(new_metrics)} gated-direction "
+            f"metric(s) have no comparable history "
+            f"({', '.join(new_metrics)}) — more than the allowed "
+            f"{args.max_new_metrics}. A renamed metric dodges the gate as "
+            "a perpetual 'new' one; re-register the rename or raise "
+            "--max_new_metrics for a round that really adds legs."
+        )
+        rc = 1
+    if rc == 0:
+        print("OK — no metric regressed past its tolerance")
+    summary_line(compared=True, gated=len(gated), regressions=regressions,
+                 new_metrics=new_metrics, skipped_chip_records=n_skipped)
+    return rc
 
 
 if __name__ == "__main__":

@@ -51,13 +51,16 @@ from pathlib import Path
 # communication-budget PR): control/* scalar namespace, the ledger's
 # per-rung "rungs" accounting block (cum bytes == sum over rungs of
 # active-rung bytes, live-count-weighted under masking), header/flight
-# "controller" block. Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4)
+# "controller" block; v5 (pipelined round execution PR): pipeline/*
+# scalar namespace (occupancy in [0, 1] and integer staged_rounds
+# enforced below), spans thread_name "M" metadata events + per-lane
+# tids. Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
-                   "control/")
+                   "control/", "pipeline/")
 
 
 class SchemaError(ValueError):
@@ -170,6 +173,32 @@ def _check_scalar_value(v, name: str, where: str) -> None:
         )
 
 
+def _check_pipeline_scalar(name: str, v, where: str) -> None:
+    """v5 ``pipeline/*`` value invariants. These are host-computed gauges
+    (never legitimately non-finite, unlike a diverging loss), so the
+    nan/inf markers are rejected too: ``occupancy`` is staged/depth and
+    must be a real fraction of the window; ``staged_rounds`` is a queue
+    COUNT and must be a non-negative integer — a fractional or negative
+    value means the writer miscounted, exactly what this check catches."""
+    if not name.startswith("pipeline/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "pipeline/occupancy" and not 0.0 <= v <= 1.0:
+        raise SchemaError(
+            f"{where}: pipeline/occupancy {v} outside [0, 1] — occupancy "
+            "is staged_rounds / pipeline_depth by definition"
+        )
+    if name == "pipeline/staged_rounds" and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: pipeline/staged_rounds {v} is not a non-negative "
+            "integer — it counts whole staged rounds"
+        )
+
+
 def validate_metrics_jsonl(path) -> int:
     """Validate a metrics.jsonl; returns the number of scalar records."""
     n_scalars = 0
@@ -204,6 +233,7 @@ def validate_metrics_jsonl(path) -> int:
             if "value" not in rec:
                 raise SchemaError(f"{where}: missing required field 'value'")
             _check_scalar_value(rec["value"], name, where)
+            _check_pipeline_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
@@ -381,6 +411,7 @@ def validate_flight(path) -> dict:
         for name, v in scalars.items():
             _check_scalar_name(name, w, allow_bare_aux=True)
             _check_scalar_value(v, name, w)
+            _check_pipeline_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step
@@ -513,6 +544,7 @@ def validate_spans(path) -> dict:
     events = _req(rec, "traceEvents", list, where)
     if not events:
         raise SchemaError(f"{where}: empty traceEvents")
+    n_spans = 0
     for j, ev in enumerate(events):
         w = f"{where}:traceEvents[{j}]"
         if not isinstance(ev, dict):
@@ -520,14 +552,47 @@ def validate_spans(path) -> dict:
         name = _req(ev, "name", str, w)
         if not name:
             raise SchemaError(f"{w}: empty event name")
+        if ev.get("ph") == "M":
+            # v5 thread-aware spans: lane-naming metadata (the prefetch
+            # worker's track label) — the only metadata kind the writer
+            # emits, so anything else is a writer bug
+            if name != "thread_name":
+                raise SchemaError(
+                    f"{w}: unknown metadata event {name!r} (only "
+                    "thread_name is in the schema)"
+                )
+            args = _req(ev, "args", dict, w)
+            if not isinstance(args.get("name"), str) or not args["name"]:
+                raise SchemaError(
+                    f"{w}: thread_name metadata needs a non-empty "
+                    "args.name"
+                )
+            mtid = _req(ev, "tid", int, w)
+            if isinstance(mtid, bool) or mtid < 0:
+                raise SchemaError(
+                    f"{w}: tid must be a non-negative lane int, got "
+                    f"{mtid!r}"
+                )
+            continue
         if ev.get("ph") != "X":
-            raise SchemaError(f"{w}: ph must be 'X' (complete event)")
+            raise SchemaError(
+                f"{w}: ph must be 'X' (complete event) or 'M' "
+                "(thread_name metadata, v5)"
+            )
         for f_ in ("ts", "dur"):
             v = _req(ev, f_, (int, float), w)
             if v < 0:
                 raise SchemaError(f"{w}: negative {f_}")
+        tid = ev.get("tid")
+        if isinstance(tid, bool) or not isinstance(tid, int) or tid < 0:
+            raise SchemaError(
+                f"{w}: tid must be a non-negative lane int, got {tid!r}"
+            )
         args = _req(ev, "args", dict, w)
         _req(args, "step", int, w + ":args")
+        n_spans += 1
+    if n_spans == 0:
+        raise SchemaError(f"{where}: no complete ('X') span events")
     return rec
 
 

@@ -51,8 +51,8 @@ def test_detects_throughput_regression(tmp_path):
     _write(tmp_path, "BENCH_r01.json", BASELINE)
     _write(tmp_path, "BENCH_r02.json", {**BASELINE, "value": 19000.0})
     assert mod.main(["--dir", str(tmp_path)]) == 1
-    regs, _ = mod.check_regression([BASELINE],
-                                   {**BASELINE, "value": 19000.0})
+    regs, _, _ = mod.check_regression([BASELINE],
+                                      {**BASELINE, "value": 19000.0})
     assert [r["metric"] for r in regs] == ["value"]
     assert regs[0]["direction"] == "up"
 
@@ -71,7 +71,7 @@ def test_median_baseline_is_outlier_robust(tmp_path):
     'regression' — the baseline is the MEDIAN of the trajectory."""
     mod = _gate()
     hist = [BASELINE, {**BASELINE, "value": 64000.0}, BASELINE]
-    regs, _ = mod.check_regression(hist, dict(BASELINE))
+    regs, _, _ = mod.check_regression(hist, dict(BASELINE))
     assert regs == []
 
 
@@ -84,11 +84,14 @@ def test_cross_chip_records_are_excluded(tmp_path):
     _write(tmp_path, "BENCH_r02.json", BASELINE)
     # the v4 90k number would gate the v5e 32k run without the exclusion
     assert mod.main(["--dir", str(tmp_path)]) == 0
-    regs, notes = mod.check_regression(
+    regs, new, notes = mod.check_regression(
         [{**BASELINE, "chip": "TPU v4", "value": 90000.0}], dict(BASELINE)
     )
     assert regs == []
     assert any("TPU v4" in n for n in notes)
+    # every gated metric lost its history to the chip exclusion — they are
+    # all reported as new/no-history, not silently passed
+    assert "value" in new
 
 
 def test_single_record_and_informational_keys_pass(tmp_path):
@@ -114,3 +117,73 @@ def test_raw_bench_line_format_accepted(tmp_path):
     (tmp_path / "BENCH_r01.json").write_text(json.dumps(BASELINE))
     _write(tmp_path, "BENCH_r02.json", BASELINE)
     assert mod.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_new_metrics_counted_and_guarded(tmp_path, capsys):
+    """Pipeline-PR satellite: 'no comparable history' is no longer a
+    silent pass — new metrics are counted in the summary/JSON output, and
+    --max_new_metrics turns a rename (perpetually 'new', never compared)
+    into a gate failure."""
+    mod = _gate()
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    # a rename: the old key vanishes, a 'new' one appears with no history
+    renamed = {k: v for k, v in BASELINE.items()
+               if k != "gpt2_sketch_tokens_per_sec"}
+    renamed["gpt2_sketch_v2_tokens_per_sec"] = 32000.0
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    assert mod.main(["--dir", str(tmp_path), "--max_new_metrics", "0"]) == 0
+    _write(tmp_path, "BENCH_r02.json", renamed)
+    capsys.readouterr()
+    # unguarded: still a pass, but the JSON summary names the new metric
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["kind"] == "bench_regression"
+    assert summary["new_metrics"] == ["gpt2_sketch_v2_tokens_per_sec"]
+    assert summary["regressions"] == []
+    # guarded: the rename can no longer dodge the gate
+    assert mod.main(["--dir", str(tmp_path), "--max_new_metrics", "0"]) == 1
+    assert mod.main(["--dir", str(tmp_path), "--max_new_metrics", "1"]) == 0
+    # check_regression surfaces the list directly too
+    _, new, _ = mod.check_regression([BASELINE], renamed)
+    assert new == ["gpt2_sketch_v2_tokens_per_sec"]
+
+
+def test_pipeline_leg_metrics_registered():
+    """The sketch_pipelined bench leg's gate-worthy keys have directions
+    (throughput + occupancy gate; the near-zero stall stays
+    informational — relative tolerance on ~0 ms is noise)."""
+    mod = _gate()
+    assert mod.metric_direction("sketch_pipelined_samples_per_sec") == "up"
+    assert mod.metric_direction("sketch_pipeline_sync_samples_per_sec") \
+        == "up"
+    assert mod.metric_direction("sketch_pipelined_occupancy") == "up"
+    assert mod.metric_direction("sketch_pipelined_host_stall_ms") is None
+
+
+def test_json_summary_always_last_line(tmp_path, capsys):
+    """The machine-readable summary is the last stdout line in every exit
+    path (nothing-to-compare included)."""
+    mod = _gate()
+    assert mod.main(["--dir", str(tmp_path)]) == 0  # no records at all
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary == {"kind": "bench_regression", "compared": False,
+                       "gated": 0, "regressions": [], "new_metrics": [],
+                       "skipped_chip_records": 0}
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    _write(tmp_path, "BENCH_r02.json", {**BASELINE, "value": 19000.0})
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [r["metric"] for r in summary["regressions"]] == ["value"]
+    # error exits too: an unreadable record and a usage error both still
+    # end with a parseable summary carrying the error text
+    (tmp_path / "BENCH_r03.json").write_text("{truncated")
+    assert mod.main(["--dir", str(tmp_path)]) == 2
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "unreadable" in summary["error"]
+    assert mod.main(["--dir", str(tmp_path), "--tolerance", "-1"]) == 2
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["error"] == "tolerance must be >= 0"
+    # an argparse-level usage error (unknown flag) honors the contract too
+    assert mod.main(["--max-new-metrics", "0"]) == 2
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "argument parsing failed" in summary["error"]

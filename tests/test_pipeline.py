@@ -1,0 +1,711 @@
+"""Pipelined round execution (pipeline/) tests.
+
+The subsystem's one non-negotiable claim is BIT-EXACTNESS: any
+``--pipeline_depth`` produces the same training as depth 0, because every
+prefetched input is a pure function of the round index and dispatch/drain
+order is preserved. Pinned here at three levels: the RoundWork stream vs
+the synchronous realization, session-level training (plain, fedsim-masked,
+and across a compression-ladder rung switch — zero retraces), and the
+cv_train e2e acceptance run (bernoulli dropout + 3-rung ef_feedback
+ladder + mid-run checkpoint resume). The prefetch-thread fault paths
+(corrupt batch, exhausted range, fedsim realization error, dead worker)
+must surface the ORIGINAL traceback at the consuming round — with a
+flight dump through the runner — and shutdown must join cleanly, never
+hang (the ``timeout`` marks document the bound; the tests also enforce
+their own join deadlines since this container lacks pytest-timeout)."""
+
+import json
+import os
+import traceback
+
+import numpy as np
+import pytest
+from test_round import BASE, _setup
+
+from commefficient_tpu.data import FedSampler
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.pipeline import (
+    PipelinedRounds,
+    PrefetchWorkerDied,
+    RoundPrefetcher,
+)
+from commefficient_tpu.utils.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(REPO, "scripts", "check_telemetry_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _session_and_sampler(**kw):
+    cfg = Config(**{**BASE, **kw})
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    return cfg, sess, sampler
+
+
+def _lr_fn(step):
+    return 0.3 - 0.01 * step
+
+
+# ---------------------------------------------------------------------------
+# prefetcher: the staged stream IS the synchronous realization
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_matches_synchronous_realization():
+    cfg, sess, sampler = _session_and_sampler(
+        mode="true_topk", error_type="virtual", k=40,
+        availability="bernoulli", dropout_prob=0.3,
+    )
+    pf = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                         depth=2, start_step=0, stop_step=6).start()
+    try:
+        for step in range(6):
+            work = pf.get(step)
+            ids, batch = sampler.sample_round(step)
+            env = sess.fedsim_env.round_env(step)
+            assert work.step == step
+            assert work.lr == float(_lr_fn(step))
+            np.testing.assert_array_equal(work.client_ids, ids)
+            for k in batch:
+                # staged device arrays hold the exact host bytes
+                np.testing.assert_array_equal(
+                    np.asarray(work.batch[k]), batch[k]
+                )
+            np.testing.assert_array_equal(work.env.live, env.live)
+            np.testing.assert_array_equal(work.env.corrupt, env.corrupt)
+            assert work.env.stats == env.stats
+            assert work.host_ms >= 0.0
+    finally:
+        assert pf.close()
+
+
+def test_prefetcher_in_order_contract_and_exhaustion():
+    cfg, sess, sampler = _session_and_sampler(mode="uncompressed")
+    pf = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                         depth=2, start_step=0, stop_step=2).start()
+    try:
+        pf.get(0)
+        with pytest.raises(RuntimeError, match="order violated"):
+            pf.get(5)  # the worker staged round 1, the consumer skipped it
+    finally:
+        assert pf.close()
+    pf = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                         depth=2, start_step=0, stop_step=1).start()
+    try:
+        pf.get(0)
+        with pytest.raises(PrefetchWorkerDied, match="exhausted"):
+            pf.get(1)  # past stop_step: a loud error, never a hang
+    finally:
+        assert pf.close()
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness vs the synchronous loop (session level)
+# ---------------------------------------------------------------------------
+
+def _run_sync(cfg, sampler_seed=1, n_rounds=6):
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size,
+                         seed=sampler_seed)
+    out = []
+    for r in range(n_rounds):
+        ids, batch = sampler.sample_round(r)
+        env = (sess.fedsim_env.round_env(r)
+               if sess.fedsim_env is not None else None)
+        m = sess.train_round(ids, batch, _lr_fn(r), env=env)
+        out.append(m)
+    return sess, out
+
+
+def _run_pipelined(cfg, sampler_seed=1, n_rounds=6):
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size,
+                         seed=sampler_seed)
+    engine = PipelinedRounds(cfg, sess, sampler, _lr_fn,
+                             num_rounds=n_rounds,
+                             steps_per_epoch=n_rounds).start(0)
+    out = []
+    try:
+        for _s, _lr, m in engine.epoch_rounds(0, 0):
+            out.append(m)
+    finally:
+        engine.close()
+    return sess, engine, out
+
+
+@pytest.mark.parametrize("kw", [
+    dict(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+         k=40, num_rows=3, num_cols=512, pipeline_depth=2),
+    dict(mode="local_topk", error_type="local", k=40, pipeline_depth=3,
+         availability="bernoulli", dropout_prob=0.3),
+])
+def test_pipelined_training_bit_exact_vs_sync(kw):
+    """Depth 2/3 training == synchronous training, bit for bit: final
+    params AND every per-round device metric (fedsim-masked rounds
+    included — the staged RoundEnvs are the same pure function)."""
+    cfg = Config(**{**BASE, **kw})
+    s_sync, m_sync = _run_sync(cfg)
+    s_pipe, _, m_pipe = _run_pipelined(cfg)
+    np.testing.assert_array_equal(
+        np.asarray(s_sync.state.params_vec), np.asarray(s_pipe.state.params_vec)
+    )
+    assert len(m_sync) == len(m_pipe)
+    for a, b in zip(m_sync, m_pipe):
+        assert set(a) == set(b)
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]), err_msg=k)
+
+
+def test_pipelined_index_path_bit_exact():
+    """The device-resident index round under the pipeline: the prefetcher
+    stages [W, B] sample indices + plan (stage_round_indices), dispatch
+    passes the committed arrays through without a host round-trip, and
+    training is bit-exact vs the synchronous index path."""
+    cfg = Config(**{**BASE, "mode": "true_topk", "error_type": "virtual",
+                    "k": 40, "pipeline_depth": 2})
+    ds, params, loss_fn = _setup(cfg.num_clients)
+
+    def build():
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        assert sess.maybe_attach_data(ds, sampler), (
+            "TinyMLP data must take the device-resident path"
+        )
+        return sess, sampler
+
+    sess_a, sampler_a = build()
+    for r in range(5):
+        ids, idx, plan = sampler_a.sample_round_indices(r)
+        sess_a.train_round_indices(ids, idx, plan, _lr_fn(r))
+    sess_b, sampler_b = build()
+    engine = PipelinedRounds(cfg, sess_b, sampler_b, _lr_fn, num_rounds=5,
+                             steps_per_epoch=5).start(0)
+    try:
+        n = sum(1 for _ in engine.epoch_rounds(0, 0))
+    finally:
+        engine.close()
+    assert n == 5
+    np.testing.assert_array_equal(np.asarray(sess_a.state.params_vec),
+                                  np.asarray(sess_b.state.params_vec))
+
+
+def test_pipelined_ladder_switch_zero_retraces():
+    """A mid-run rung switch under depth 2: the staged window dispatches
+    through the NEW rung's prewarmed program (no restage — inputs are
+    rung-invariant), the engine records the quiesce, and the sentinel
+    counts zero retraces; training stays bit-exact vs the synchronous
+    ladder run."""
+    kw = dict(
+        mode="local_topk", error_type="local", topk_method="threshold",
+        telemetry_level=1, control_policy="fixed",
+        control_schedule="0-2=0,3-=1", ladder="k=60,30", pipeline_depth=2,
+    )
+    from commefficient_tpu.control import build_controller
+
+    def run(depth):
+        cfg = Config(**{**BASE, **kw, "pipeline_depth": depth})
+        ds, params, loss_fn = _setup(cfg.num_clients)
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        ctrl = build_controller(cfg, sess, num_rounds=6)
+        ctrl.prewarm(sampler, _lr_fn(0))
+        if depth:
+            engine = PipelinedRounds(cfg, sess, sampler, _lr_fn,
+                                     num_rounds=6,
+                                     steps_per_epoch=6).start(0)
+            try:
+                ms = [m for _s, _lr, m in engine.epoch_rounds(0, 0)]
+            finally:
+                engine.close()
+        else:
+            engine, ms = None, []
+            for r in range(6):
+                ids, batch = sampler.sample_round(r)
+                ms.append(sess.train_round(ids, batch, _lr_fn(r)))
+        return sess, ctrl, engine, ms
+
+    s0, c0, _, m0 = run(0)
+    s2, c2, eng, m2 = run(2)
+    assert c0.switches == c2.switches == 1
+    assert eng.quiesces == 1  # the switch listener saw the quiesce point
+    assert s2.retrace_sentinel.retraces == 0
+    np.testing.assert_array_equal(np.asarray(s0.state.params_vec),
+                                  np.asarray(s2.state.params_vec))
+    # identical rung trail; the pipelined run adds ONLY pipeline/* scalars
+    for a, b in zip(m0, m2):
+        assert float(np.asarray(a["control/rung"])) == \
+            float(np.asarray(b["control/rung"]))
+        assert set(b) - set(a) == {"pipeline/occupancy",
+                                   "pipeline/host_stall_ms",
+                                   "pipeline/staged_rounds"}
+
+
+def test_pipeline_scalars_ride_metrics_and_validate():
+    """pipeline/* scalars (level >= 1): occupancy in [0, 1],
+    staged_rounds an integer <= depth — written through the real
+    MetricsWriter/drain and accepted by the REAL schema checker (v5),
+    which also rejects tampered values."""
+    import tempfile
+
+    from commefficient_tpu.utils.logging import MetricsWriter, \
+        drain_round_metrics
+
+    cfg = Config(**{**BASE, "mode": "uncompressed", "telemetry_level": 1,
+                    "pipeline_depth": 2})
+    _, _, out = _run_pipelined(cfg, n_rounds=4)
+    for m in out:
+        occ = float(np.asarray(m["pipeline/occupancy"]))
+        staged = float(np.asarray(m["pipeline/staged_rounds"]))
+        assert 0.0 <= occ <= 1.0
+        assert staged == int(staged) and 0 <= staged <= 2
+        assert occ == staged / 2
+        assert float(np.asarray(m["pipeline/host_stall_ms"])) >= 0.0
+    with tempfile.TemporaryDirectory() as td:
+        writer = MetricsWriter(td, cfg=cfg)
+        pending = [(i, 0.1, m) for i, m in enumerate(out)]
+        drain_round_metrics(pending, writer, lambda *a: None)
+        writer.close()
+        mod = _checker()
+        assert mod.validate_metrics_jsonl(os.path.join(td, "metrics.jsonl"))
+        # rejection self-tests: the checker enforces the v5 invariants
+        path = os.path.join(td, "bad.jsonl")
+        header = open(os.path.join(td, "metrics.jsonl")).readline()
+        for bad, msg in [
+            ({"name": "pipeline/occupancy", "value": 1.5, "step": 0,
+              "t": 0.0}, "outside"),
+            ({"name": "pipeline/staged_rounds", "value": 1.5, "step": 0,
+              "t": 0.0}, "integer"),
+            ({"name": "pipeline/occupancy", "value": "nan", "step": 0,
+              "t": 0.0}, "finite"),
+        ]:
+            with open(path, "w") as f:
+                f.write(header)
+                f.write(json.dumps(bad) + "\n")
+            with pytest.raises(mod.SchemaError, match=msg):
+                mod.validate_metrics_jsonl(path)
+
+
+def test_spans_thread_aware_prefetch_lane(tmp_path):
+    """Schema v5 thread-aware spans: the prefetch worker's spans land on
+    their OWN lane (tid != 0) with a thread_name metadata event and the
+    step they realize; dispatch spans stay on lane 0. The dump passes the
+    real checker."""
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    cfg, sess, sampler = _session_and_sampler(mode="uncompressed",
+                                              telemetry_level=1)
+    spans = PhaseSpans(str(tmp_path))
+    sess.spans = spans
+    engine = PipelinedRounds(cfg.replace(pipeline_depth=2), sess, sampler,
+                             _lr_fn, num_rounds=4, steps_per_epoch=4,
+                             spans=spans).start(0)
+    try:
+        for _ in engine.epoch_rounds(0, 0):
+            pass
+    finally:
+        engine.close()
+    sess.spans = None
+    path = spans.close()
+    rec = _checker().validate_spans(path)
+    evs = rec["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "round-prefetch" for e in meta)
+    lane = next(e["tid"] for e in meta
+                if e["args"]["name"] == "round-prefetch")
+    assert lane != 0
+    pre = [e for e in evs if e["ph"] == "X"
+           and e["name"].startswith("prefetch_")]
+    assert pre and all(e["tid"] == lane for e in pre)
+    # the prefetch lane stamps the round it REALIZES, not the consumer's
+    # current round clock
+    assert sorted({e["args"]["step"] for e in pre
+                   if e["name"] == "prefetch_realize"}) == [0, 1, 2, 3]
+    disp = [e for e in evs if e["ph"] == "X"
+            and e["name"] == "round_dispatch"]
+    assert disp and all(e["tid"] == 0 for e in disp)
+
+
+# ---------------------------------------------------------------------------
+# fault paths: original traceback at the consuming round, never a hang
+# ---------------------------------------------------------------------------
+
+class _PoisonedSampler:
+    """Delegates to a real FedSampler but corrupts round ``bad_round``."""
+
+    def __init__(self, real, bad_round, exc):
+        self._real = real
+        self._bad = bad_round
+        self._exc = exc
+
+    def steps_per_epoch(self):
+        return self._real.steps_per_epoch()
+
+    def sample_round(self, r):
+        if r == self._bad:
+            raise self._exc
+        return self._real.sample_round(r)
+
+
+@pytest.mark.timeout(120)
+def test_worker_fault_surfaces_original_traceback():
+    """A corrupt batch at round 3 raises AT the consuming round 3 —
+    original exception object, worker-side frames intact — after rounds
+    0..2 trained normally; close() still joins."""
+    cfg, sess, sampler = _session_and_sampler(mode="uncompressed",
+                                              pipeline_depth=2)
+    poisoned = _PoisonedSampler(sampler, 3,
+                                ValueError("corrupt batch payload"))
+    engine = PipelinedRounds(cfg, sess, poisoned, _lr_fn, num_rounds=6,
+                             steps_per_epoch=6).start(0)
+    try:
+        seen = []
+        with pytest.raises(ValueError, match="corrupt batch payload") as ei:
+            for s, _lr, _m in engine.epoch_rounds(0, 0):
+                seen.append(s)
+        assert seen == [0, 1, 2]
+        frames = "".join(traceback.format_tb(ei.value.__traceback__))
+        assert "_realize" in frames, (
+            "the worker-side traceback must survive the thread hop"
+        )
+    finally:
+        engine.close()
+    # the prefetcher must be joinable after the fault (bounded deadline)
+    assert engine._prefetcher.close(timeout=10.0)
+
+
+@pytest.mark.timeout(120)
+def test_fedsim_realization_fault_surfaces():
+    """A fedsim env realization error in the worker surfaces at the
+    consuming round with the original frames (the 'fedsim validation
+    error' fault class)."""
+    cfg, sess, sampler = _session_and_sampler(
+        mode="uncompressed", availability="bernoulli", dropout_prob=0.2,
+        pipeline_depth=2,
+    )
+
+    def boom(round_idx):
+        raise RuntimeError(f"fedsim validation failed at {round_idx}")
+
+    sess.fedsim_env.round_env = boom
+    engine = PipelinedRounds(cfg, sess, sampler, _lr_fn, num_rounds=4,
+                             steps_per_epoch=4).start(0)
+    try:
+        with pytest.raises(RuntimeError, match="fedsim validation failed"):
+            for _ in engine.epoch_rounds(0, 0):
+                pass
+    finally:
+        engine.close()
+    assert engine._prefetcher.close(timeout=10.0)
+
+
+@pytest.mark.timeout(120)
+def test_worker_exit_does_not_mask_staged_items_or_faults(monkeypatch):
+    """A finished/dead worker must never shadow what it already staged:
+    items (and the exhaustion sentinel) enqueued before the thread exited
+    are still consumed in order; only a worker that died WITHOUT leaving
+    an item or exception raises the generic PrefetchWorkerDied."""
+    cfg, sess, sampler = _session_and_sampler(mode="uncompressed")
+    pf = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                         depth=3, start_step=0, stop_step=2).start()
+    pf._thread.join(timeout=30)  # 2 rounds + _END fit the depth-3 queue
+    assert not pf._thread.is_alive()
+    # the gauge counts only real WORK: the queue holds 3 items here but
+    # the _END sentinel must not inflate staged_rounds/occupancy
+    assert pf.staged_rounds == 2
+    assert pf.get(0).step == 0
+    assert pf.staged_rounds == 1
+    assert pf.get(1).step == 1
+    with pytest.raises(PrefetchWorkerDied, match="exhausted"):
+        pf.get(2)
+    assert pf.close()
+    # the genuinely-dead case: the worker exits without staging anything
+    # (simulated hard death) — a loud, honest error, not a hang
+    monkeypatch.setattr(RoundPrefetcher, "_run", lambda self: None)
+    dead = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                           depth=2, start_step=0, stop_step=4).start()
+    dead._thread.join(timeout=30)
+    with pytest.raises(PrefetchWorkerDied, match="died before staging"):
+        dead.get(0)
+    assert dead.close()
+
+
+@pytest.mark.timeout(120)
+def test_shutdown_joins_cleanly_with_staged_window():
+    """Abandoning a full in-flight window (consumer stops early) must
+    join the worker within the deadline — the bounded-queue put polls the
+    stop flag, so a full queue cannot deadlock shutdown."""
+    cfg, sess, sampler = _session_and_sampler(mode="uncompressed")
+    pf = RoundPrefetcher(session=sess, sampler=sampler, lr_fn=_lr_fn,
+                         depth=3, start_step=0, stop_step=100).start()
+    pf.get(0)  # worker is live and the window refills behind this
+    assert pf.close(timeout=10.0), "prefetch worker failed to join"
+    assert not pf._thread.is_alive()
+
+
+@pytest.mark.timeout(120)
+def test_runner_flight_dump_on_worker_fault(tmp_path):
+    """The full-loop contract: a prefetch-worker fault crashes the shared
+    runner, which drains the dispatched in-flight rounds (true round
+    indices in the ledger/flight) and dumps a flight record for the
+    post-mortem — same forensics as a synchronous crash."""
+    from commefficient_tpu.train.cv_train import train_loop
+    from commefficient_tpu.utils.logging import MetricsWriter
+
+    cfg = Config(**{**BASE, "mode": "uncompressed", "telemetry_level": 1,
+                    "pipeline_depth": 2, "num_epochs": 1,
+                    "perf_audit": False, "local_batch_size": 4})
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    poisoned = _PoisonedSampler(sampler, 4, ValueError("bad round 4"))
+    test_ds = ds  # never reached: the crash fires before epoch-end eval
+    writer = MetricsWriter(str(tmp_path / "run"), cfg=cfg)
+    with pytest.raises(ValueError, match="bad round 4"):
+        train_loop(cfg, sess, poisoned, test_ds, writer)
+    writer.close()
+    run_dir = tmp_path / "run"
+    flights = list(run_dir.glob("flight_*.json"))
+    assert flights, "worker fault must dump a flight record"
+    rec = _checker().validate_flight(flights[0])
+    assert "bad round 4" in rec["reason"]
+    # the dispatched rounds 0..3 were drained with their true indices
+    assert [r["step"] for r in rec["records"]] == [0, 1, 2, 3]
+    ledger = run_dir / "comm_ledger.json"
+    assert _checker().validate_comm_ledger(ledger)["rounds"] == 4
+
+
+# ---------------------------------------------------------------------------
+# the full runner path at TinyMLP scale (default-tier twin of the e2e)
+# ---------------------------------------------------------------------------
+
+def test_runner_pipelined_resume_bit_exact_tinymlp(tmp_path):
+    """The cv_train e2e's default-tier twin on the TinyMLP task: the REAL
+    shared runner (train_loop) at depth 2 vs depth 0 under bernoulli
+    dropout + a 3-rung ef_feedback ladder — bit-identical final params
+    and metrics.jsonl scalar sequence, >= 1 rung switch, zero retraces —
+    and a resume from a mid-run checkpoint reproduces the tail."""
+    from commefficient_tpu.data import FedDataset
+    from commefficient_tpu.train.cv_train import train_loop
+    from commefficient_tpu.utils.checkpoint import FedCheckpointer
+    from commefficient_tpu.utils.logging import MetricsWriter
+
+    ds, params, loss_fn = _setup(12)
+    test_ds = FedDataset({"x": ds.data["x"][:40], "y": ds.data["y"][:40]},
+                         1, seed=0)
+
+    def run(depth, tag, resume=False):
+        cfg = Config(**{**BASE, **dict(
+            mode="true_topk", error_type="virtual", virtual_momentum=0.9,
+            topk_method="threshold", telemetry_level=1, perf_audit=False,
+            availability="bernoulli", dropout_prob=0.25,
+            control_policy="ef_feedback", ladder="k=60,30,15",
+            control_ef_up=1e-9, control_ef_down=-1.0, control_hysteresis=1,
+            num_epochs=1, pivot_epoch=1, lr_scale=0.1,
+            checkpoint_dir=str(tmp_path / f"ckpt{tag}"), checkpoint_every=5,
+            pipeline_depth=depth, resume=resume,
+        )})
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        run_dir = str(tmp_path / f"run{tag}" / ("resume" if resume else "full"))
+        writer = MetricsWriter(run_dir, cfg=cfg)
+        ck = FedCheckpointer(cfg)
+        try:
+            train_loop(cfg, sess, sampler, test_ds, writer,
+                       eval_batch_size=32, checkpointer=ck)
+        finally:
+            ck.close()
+            writer.close()
+        return sess, run_dir
+
+    s0, dir0 = run(0, "_d0")
+    s2, dir2 = run(2, "_d2")
+    np.testing.assert_array_equal(np.asarray(s0.state.params_vec),
+                                  np.asarray(s2.state.params_vec))
+    seq0, seq2 = _scalar_sequence(dir0), _scalar_sequence(dir2)
+    assert seq0 and seq0 == seq2
+    rungs = [v for n, v, _s in seq2 if n == "control/rung"]
+    assert rungs[0] == 2.0 and len(set(rungs)) >= 2, rungs
+    assert {v for n, v, _s in seq2 if n == "xla/retraces"} == {0.0}
+    assert s2.retrace_sentinel.retraces == 0
+    # resume: drop all but the FIRST surviving checkpoint and replay
+    import shutil
+
+    kept = sorted(int(p.name) for p in (tmp_path / "ckpt_d2").iterdir()
+                  if p.name.isdigit())
+    resume_step = kept[0]
+    steps_total = max(s for _n, _v, s in seq0)
+    assert resume_step < steps_total, kept
+    for s in kept[1:]:
+        shutil.rmtree(tmp_path / "ckpt_d2" / str(s))
+    s2r, dir2r = run(2, "_d2", resume=True)
+    np.testing.assert_array_equal(np.asarray(s0.state.params_vec),
+                                  np.asarray(s2r.state.params_vec))
+    drop = ("comm/",)  # process-local cumulative ledger, by design
+    tail = [r for r in _scalar_sequence(dir2r)
+            if r[2] >= resume_step and not r[0].startswith(drop)]
+    want = [r for r in seq0 if r[2] >= resume_step
+            and not r[0].startswith(drop)]
+    assert tail == want, "resume diverged from the uninterrupted run"
+
+
+# ---------------------------------------------------------------------------
+# cv_train e2e (the PR acceptance pin)
+# ---------------------------------------------------------------------------
+
+def _scalar_sequence(logdir, *, exclude_prefix="pipeline/"):
+    """Every scalar record under ``logdir`` as (name, value, step) tuples
+    in file order — the bit-exactness comparison unit (wall-time ``t`` is
+    the only field that may differ between twin runs). ``pipeline/*`` is
+    excluded: those gauges exist only at depth > 0 by design."""
+    out = []
+    for root, _, files in os.walk(logdir):
+        for f in sorted(files):
+            if f != "metrics.jsonl":
+                continue
+            with open(os.path.join(root, f)) as fh:
+                for line in fh:
+                    rec = json.loads(line)
+                    if "name" not in rec:
+                        continue  # run header
+                    if rec["name"].startswith(exclude_prefix):
+                        continue
+                    out.append((rec["name"], rec["value"], rec["step"]))
+    return out
+
+
+def _final_params(ckpt_dir):
+    """The final checkpoint's saved fed_state leaves (raw numpy)."""
+    import orbax.checkpoint as ocp
+
+    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    restored = mngr.restore(mngr.latest_step(),
+                            args=ocp.args.StandardRestore())
+    mngr.close()
+    return restored["fed_state"]
+
+
+@pytest.mark.slow  # ~4-5 min of femnist/resnet9 compiles (3 cv_main runs)
+# on the 1-core CPU budget; every claim it pins holds DEFAULT-tier
+# coverage at TinyMLP scale through the same shared runner
+# (test_runner_pipelined_resume_bit_exact_tinymlp + the session-level
+# bit-exactness tests above) — this is the full-entry artifact check,
+# same discipline as test_cv_train_budget_hard_stop_e2e
+def test_cv_train_pipeline_depth2_bit_exact_e2e(tmp_path):
+    """Acceptance: cv_train at --pipeline_depth 2 produces bit-identical
+    final params and metrics.jsonl scalar sequence vs --pipeline_depth 0,
+    under a bernoulli-dropout fedsim env AND a 3-rung ef_feedback ladder
+    (identical rung sequence, xla/retraces == 0 throughout), and a resume
+    from a mid-run checkpoint reproduces it. Checkpoint boundaries force
+    mid-epoch drains, so the policy decides on mid-epoch telemetry — the
+    hardest case for the depth-parity claim."""
+    from commefficient_tpu.train.cv_train import main as cv_main
+
+    def kw(depth, tag):
+        return dict(
+            dataset_name="femnist",
+            model="resnet9",
+            mode="true_topk",
+            error_type="virtual",
+            virtual_momentum=0.9,
+            topk_method="threshold",
+            num_clients=6,
+            num_workers=4,
+            num_devices=4,
+            local_batch_size=32,  # 5 rounds/epoch on the femnist stand-in
+            num_epochs=2,
+            pivot_epoch=1,
+            lr_scale=0.1,
+            dataset_dir=str(tmp_path),
+            seed=0,
+            telemetry_level=1,
+            perf_audit=False,  # the AOT audit is test_xla_audit territory
+            availability="bernoulli",
+            dropout_prob=0.25,
+            control_policy="ef_feedback",
+            ladder="k=4000,2000,1000",
+            # force deterministic climbing: any EF growth climbs, and the
+            # bank grows from zero in the first rounds by construction
+            control_ef_up=1e-9,
+            control_ef_down=-1.0,
+            control_hysteresis=1,
+            # checkpoints every 3 rounds: mid-epoch drains (policy decides
+            # mid-epoch) AND the resume leg's restore point. The schedule
+            # is config, hence identical across depths — drain points are
+            # part of the determinism contract.
+            checkpoint_dir=str(tmp_path / f"ckpt{tag}"),
+            checkpoint_every=3,
+            pipeline_depth=depth,
+            logdir=str(tmp_path / f"runs{tag}"),
+        )
+
+    cv_main([], **kw(0, "_d0"))
+    cv_main([], **kw(2, "_d2"))
+    seq0 = _scalar_sequence(tmp_path / "runs_d0")
+    seq2 = _scalar_sequence(tmp_path / "runs_d2")
+    assert seq0, "depth-0 run wrote no scalars"
+    assert seq0 == seq2, "depth 2 diverged from depth 0 bitwise"
+    rungs = [v for n, v, _s in seq2 if n == "control/rung"]
+    assert rungs[0] == 2.0, "ef_feedback starts at the cheapest rung"
+    assert len(set(rungs)) >= 2, f"no rung switch happened: {rungs}"
+    assert {v for n, v, _s in seq2 if n == "xla/retraces"} == {0.0}, (
+        "the pipelined ladder run must stay retrace-free"
+    )
+    # depth-2's pipeline gauges exist and respect the schema invariants
+    occ = [v for n, v, _s in _scalar_sequence(
+        tmp_path / "runs_d2", exclude_prefix="\0"
+    ) if n == "pipeline/occupancy"]
+    assert occ and all(0.0 <= v <= 1.0 for v in occ)
+    # final params: bit-identical across depths (the forced final save)
+    fs0 = _final_params(tmp_path / "ckpt_d0")
+    fs2 = _final_params(tmp_path / "ckpt_d2")
+    for leaf in ("params_vec", "momentum", "error", "step"):
+        np.testing.assert_array_equal(
+            np.asarray(fs0[leaf]), np.asarray(fs2[leaf]), err_msg=leaf
+        )
+    # resume leg: drop all but the FIRST mid-run checkpoint (a kill at
+    # that round) and replay at depth 2 — the resumed run reproduces the
+    # uninterrupted scalar/rung sequence from the restore point on
+    kept = sorted(int(p.name) for p in (tmp_path / "ckpt_d2").iterdir()
+                  if p.name.isdigit())
+    resume_step = kept[0]
+    assert resume_step < 10, f"no mid-run checkpoint survived: {kept}"
+    import shutil
+
+    for s in kept[1:]:
+        shutil.rmtree(tmp_path / "ckpt_d2" / str(s))
+    cv_main([], resume=True,
+            **{**kw(2, "_d2"), "logdir": str(tmp_path / "runs_resume")})
+
+    def _no_comm(rows):
+        # comm/* cumulative bytes are PROCESS-local by design (each
+        # process's own ledger, exact over the rounds it drained — the
+        # checker validates that law per run dir), so the resumed
+        # process's comm scalars legitimately differ from the
+        # uninterrupted run's; everything else must match bitwise.
+        return [r for r in rows if not r[0].startswith("comm/")]
+
+    tail = _no_comm([r for r in _scalar_sequence(tmp_path / "runs_resume")
+                     if r[2] >= resume_step])
+    want = _no_comm([r for r in seq0 if r[2] >= resume_step])
+    assert tail == want, "resume diverged from the uninterrupted run"

@@ -267,3 +267,109 @@ def test_cli_exit_codes(tmp_path):
     assert mod.main([run_dir]) == 0
     (tmp_path / "empty").mkdir()
     assert mod.main([str(tmp_path / "empty")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# v5: pipeline/* scalars + thread-aware spans
+# ---------------------------------------------------------------------------
+
+def test_v5_pipeline_scalars_validate_and_reject(tmp_path):
+    """The pipeline/ scalar prefix is in-schema through the REAL writer;
+    the occupancy-range and staged-rounds-integer invariants are enforced
+    (tampered values rejected). The per-round-metric form is additionally
+    pinned by tests/test_pipeline.py through the real engine."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, pipeline_depth=2)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("pipeline/occupancy", s / 2.0, s)
+        writer.scalar("pipeline/host_stall_ms", 0.4, s)
+        writer.scalar("pipeline/staged_rounds", float(s), s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 15
+    lines = open(path).read().splitlines()
+    for bad_rec, msg in [
+        ({"name": "pipeline/occupancy", "value": -0.1, "step": 0,
+          "t": 1.0}, "outside \\[0, 1\\]"),
+        ({"name": "pipeline/occupancy", "value": 2.0, "step": 0,
+          "t": 1.0}, "outside \\[0, 1\\]"),
+        ({"name": "pipeline/staged_rounds", "value": 0.5, "step": 0,
+          "t": 1.0}, "integer"),
+        ({"name": "pipeline/staged_rounds", "value": -1.0, "step": 0,
+          "t": 1.0}, "integer"),
+        ({"name": "pipeline/host_stall_ms", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(lines[0] + "\n" + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def test_v5_spans_thread_metadata_validates_and_rejects(tmp_path):
+    """Thread-aware spans through the REAL recorder: lane tids + the
+    thread_name metadata event validate; a non-thread_name metadata
+    event, a negative tid, and a metadata-only dump are rejected."""
+    import threading
+
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    mod = _checker()
+    spans = PhaseSpans(str(tmp_path))
+    spans.step(2)
+    with spans.span("round_dispatch"):
+        pass
+
+    def worker():
+        spans.register_lane("round-prefetch")
+        with spans.span("prefetch_realize", step=3):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    path = spans.close()
+    rec = mod.validate_spans(path)
+    lanes = {e["tid"] for e in rec["traceEvents"] if e["ph"] == "X"}
+    assert lanes == {0, 1}
+    meta = [e for e in rec["traceEvents"] if e["ph"] == "M"]
+    assert [(e["tid"], e["args"]["name"]) for e in meta] == \
+        [(1, "round-prefetch")]
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_spans.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_spans(bad)
+
+    tampered(lambda r: r["traceEvents"].append(
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "x"}}), "unknown metadata")
+    tampered(lambda r: r["traceEvents"][0].update(tid=-1), "tid")
+    tampered(lambda r: r.update(traceEvents=meta), "no complete")
+
+
+def test_v5_spans_lane_labels_survive_ring_eviction(tmp_path):
+    """Lane-label metadata must outlive the bounded span ring: a run long
+    enough to wrap the ring many times still dumps the thread_name
+    record, or long-run traces lose their track labels."""
+    from commefficient_tpu.telemetry.spans import MAX_EVENTS, PhaseSpans
+
+    mod = _checker()
+    spans = PhaseSpans(str(tmp_path))
+    spans.register_lane("main")
+    spans.step(2)
+    for _ in range(MAX_EVENTS + 10):  # wrap the ring past the label
+        with spans.span("round_dispatch"):
+            pass
+    rec = mod.validate_spans(spans.close())
+    meta = [e for e in rec["traceEvents"] if e["ph"] == "M"]
+    assert [(e["tid"], e["args"]["name"]) for e in meta] == [(0, "main")]
