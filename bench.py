@@ -467,6 +467,57 @@ def _measure_ladder_switch(base_cfg, n_rounds: int = 8) -> dict:
     }
 
 
+def _measure_recovery(base_cfg, n_rounds: int = 4) -> dict:
+    """Cost of the resilience/ self-healing primitives on the headline
+    sketch round: the vault snapshot capture (a deliberate host sync —
+    the per-`--snapshot_every` tax a recovery-enabled run pays) and the
+    rollback restore (snapshot -> leaf re-commit through the same
+    checkpoint path), plus the sentinel's retrace count across a
+    post-rollback dispatch — which must be 0: the restored leaves land on
+    their original shardings, so the round re-dispatches the same
+    compiled program."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.resilience import RollbackVault
+    from commefficient_tpu.utils.profiling import fence
+
+    cfg = base_cfg
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply, compute_dtype=cfg.compute_dtype)
+    session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+
+    rng = np.random.default_rng(0)
+    W, B = cfg.num_workers, cfg.local_batch_size
+    ids = rng.choice(cfg.num_clients, size=W, replace=False).astype(np.int32)
+    batch = {
+        "x": rng.normal(size=(W, B, 32, 32, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(W, B)).astype(np.int32),
+    }
+    for _ in range(2):  # compile + donated-layout warmup
+        fence(session.train_round(ids, batch, 0.1)["loss"])
+    vault = RollbackVault(snapshot_every=1)
+    t0 = time.perf_counter()
+    snap = vault.snapshot(session, 2)
+    snapshot_ms = (time.perf_counter() - t0) * 1e3
+    for _ in range(n_rounds):
+        fence(session.train_round(ids, batch, 0.1)["loss"])
+    t0 = time.perf_counter()
+    vault.restore(session, snap)
+    rollback_ms = (time.perf_counter() - t0) * 1e3
+    fence(session.train_round(ids, batch, 0.1)["loss"])
+    return {
+        "sketch_resilience_snapshot_ms": round(snapshot_ms, 1),
+        "sketch_resilience_snapshot_mb": round(snap.nbytes / 2**20, 1),
+        "sketch_resilience_rollback_ms": round(rollback_ms, 1),
+        "sketch_resilience_retraces": session.retrace_sentinel.retraces,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -559,6 +610,19 @@ def main():
         else:
             rows.update(ctl)
             print(json.dumps({"metric": "sketch_ladder_switch", **ctl}))
+        # resilience PR: snapshot/rollback primitive cost on the headline
+        # round — the recovery tax is paid per --snapshot_every boundary
+        # (snapshot) and per divergence (rollback); retraces must be 0
+        # (the restore re-commits leaves onto their original shardings).
+        try:
+            res = _measure_recovery(base)
+        except Exception as e:  # noqa: BLE001
+            rows["sketch_resilience_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "sketch_resilience",
+                              "error": rows["sketch_resilience_error"]}))
+        else:
+            rows.update(res)
+            print(json.dumps({"metric": "sketch_resilience", **res}))
 
     # pipeline PR: the pipelined-execution leg rides the HEADLINE line
     # (gated by scripts/check_bench_regression.py — occupancy + samples/s

@@ -44,11 +44,14 @@ from commefficient_tpu.control.policy import (
     get_policy,
 )
 
-_BLOB_VERSION = 1
+_BLOB_VERSION = 2
 # blob layout: [version, rung, switches, rounds_seen, spent_up, spent_down,
-#               last_switch_round, *policy slots] — float64 is exact for
-# every field (byte counts stay far below 2^53)
-_BLOB_FIXED = 7
+#               last_switch_round, min_rung, *policy slots] — float64 is
+# exact for every field (byte counts stay far below 2^53). v2 adds the
+# resilience demotion floor ``min_rung`` at index 7; v1 blobs (one slot
+# shorter) still load with the floor defaulting to 0.
+_BLOB_FIXED = 8
+_BLOB_FIXED_V1 = 7
 
 
 class BudgetController:
@@ -76,6 +79,12 @@ class BudgetController:
         self.spent_up = 0
         self.spent_down = 0
         self.last_switch_round = -1
+        # resilience demotion floor (resilience/policy.py DemotePolicy):
+        # rung indices below it are off-limits — a divergence-driven
+        # degradation that outlives the policy's own decisions (every
+        # on_round_start clamps to it) and rides the checkpoint blob so a
+        # resumed run stays demoted.
+        self.min_rung = 0
         # rung-switch observers (pipeline/engine.py registers one): called
         # host-side, AFTER the dispatch-table swap + state migration and
         # BEFORE the round dispatches — the pipelined engine's quiesce
@@ -145,6 +154,10 @@ class BudgetController:
             hysteresis=self.cfg.control_hysteresis,
         ))
         target = min(max(int(target), 0), self.num_rungs - 1)
+        # resilience demotion floor: a divergence-demoted run never climbs
+        # back above the floor, whatever the policy says (higher index ==
+        # cheaper rung, so the clamp is a max)
+        target = max(target, self.min_rung)
         if self.budget_bytes is not None:
             # hard clamp, policy-independent: demote to the most expensive
             # rung that still fits the remaining budget; nothing fits ->
@@ -170,6 +183,36 @@ class BudgetController:
                 fn(step, rung, target)
         self._spend(target, live, avail)
         self.rounds_seen += 1
+        return target
+
+    def demote(self, step: int) -> int:
+        """Resilience recovery action (resilience/policy.py DemotePolicy):
+        floor the ladder one rung cheaper than the CURRENT rung and switch
+        to it now — through the same AOT-prewarmed ``set_active_rung`` +
+        ``migrate_state`` path as a policy switch, so the demotion is
+        never a retrace. Returns the new active rung (== the old one iff
+        already at the cheapest rung, in which case nothing changes and
+        the caller treats the demotion as unavailable)."""
+        old = self.session.active_rung
+        # descend from the EFFECTIVE rung — the active rung clamped to
+        # the floor: a rollback may have re-activated a pre-demotion rung
+        # from a stale snapshot blob, but every on_round_start clamps
+        # back to the floor, so one-cheaper-than-effective is the true
+        # descent (repeated recoveries walk DOWN the ladder, never replay
+        # the rung that just diverged)
+        effective = max(old, self.min_rung)
+        target = min(effective + 1, self.num_rungs - 1)
+        if target == effective:
+            # already floored at the cheapest rung — return the active
+            # rung unchanged so the caller sees the demotion as
+            # unavailable
+            return old
+        self.min_rung = max(self.min_rung, target)
+        self.session.set_active_rung(target, migrate=True)
+        self.switches += 1
+        self.last_switch_round = int(step)
+        for fn in self._switch_listeners:
+            fn(int(step), old, target)
         return target
 
     # -- telemetry ---------------------------------------------------------
@@ -247,18 +290,20 @@ class BudgetController:
         return np.asarray(
             [_BLOB_VERSION, self.session.active_rung, self.switches,
              self.rounds_seen, self.spent_up, self.spent_down,
-             self.last_switch_round, *self.policy.state()],
+             self.last_switch_round, self.min_rung, *self.policy.state()],
             np.float64,
         )
 
     def load_state_blob(self, blob) -> None:
         blob = np.asarray(blob, np.float64)
-        if int(blob[0]) != _BLOB_VERSION:
+        version = int(blob[0])
+        if version not in (1, _BLOB_VERSION):
             raise ValueError(
-                f"controller checkpoint blob version {int(blob[0])} != "
+                f"controller checkpoint blob version {version} != "
                 f"{_BLOB_VERSION} — checkpoint from an incompatible build"
             )
-        want = _BLOB_FIXED + self.policy.STATE_SLOTS
+        fixed = _BLOB_FIXED_V1 if version == 1 else _BLOB_FIXED
+        want = fixed + self.policy.STATE_SLOTS
         if blob.shape != (want,):
             raise ValueError(
                 f"controller checkpoint blob has shape {blob.shape}, "
@@ -281,7 +326,16 @@ class BudgetController:
         self.spent_up = int(blob[4])
         self.spent_down = int(blob[5])
         self.last_switch_round = int(blob[6])
-        self.policy.load_state(tuple(blob[_BLOB_FIXED:]))
+        # v1 blobs (pre-resilience) carry no demotion floor — default 0.
+        # Monotone on purpose: a resilience rollback may load a snapshot
+        # blob captured BEFORE a demote recovery raised the floor, and
+        # the floor must outlive that rewind (else a second divergence in
+        # the same window re-demotes to the same rung forever instead of
+        # descending the ladder). A fresh controller starts at 0, so a
+        # checkpoint resume still adopts the saved floor exactly.
+        self.min_rung = max(self.min_rung,
+                            0 if version == 1 else int(blob[7]))
+        self.policy.load_state(tuple(blob[fixed:]))
 
 
 def build_controller(cfg, session, num_rounds: int) -> Optional[

@@ -64,7 +64,9 @@ from commefficient_tpu.fedsim.env import (
 from commefficient_tpu.fedsim.faults import (
     CHAOS_KINDS,
     ChaosEvent,
+    has_preempt,
     parse_chaos,
+    preempt_requested,
     validate_chaos_rounds,
 )
 
@@ -75,7 +77,9 @@ __all__ = [
     "RoundEnv",
     "available_models",
     "build_environment",
+    "has_preempt",
     "parse_chaos",
+    "preempt_requested",
     "sample_availability",
     "validate_chaos_rounds",
 ]

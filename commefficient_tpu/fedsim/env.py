@@ -27,6 +27,7 @@ from commefficient_tpu.fedsim.faults import (
     ChaosEvent,
     apply_chaos,
     parse_chaos,
+    preempt_requested,
     validate_chaos_rounds,
 )
 
@@ -84,11 +85,14 @@ class FedEnvironment:
         for r in range(start, stop):
             yield self.round_env(r)
 
-    def round_env(self, round_idx: int) -> RoundEnv:
+    def round_env(self, round_idx: int, replay: bool = False) -> RoundEnv:
         """Realize round ``round_idx``'s masks + telemetry scalars —
         deterministic and resume-stable from (seed, round_idx). Pure and
         thread-safe: a fresh rng per call, nothing mutated (see
-        ``round_envs``)."""
+        ``round_envs``). ``replay=True`` marks a round re-executed after a
+        resilience/ rollback: the transient nan_client injection is
+        suppressed (faults.apply_chaos), every other draw — and therefore
+        every mask — is bit-identical to the first pass."""
         W = self.num_workers
         rng = round_rng(self.seed, round_idx)
         avail = sample_availability(
@@ -97,7 +101,7 @@ class FedEnvironment:
             period=self.period, num_cohorts=self.num_cohorts,
         )
         avail, straggler, corrupt = apply_chaos(
-            self.plan, rng, round_idx, avail
+            self.plan, rng, round_idx, avail, replay=replay
         )
         live = avail & ~straggler
         n_live = int(live.sum())
@@ -110,6 +114,10 @@ class FedEnvironment:
             "fedsim/dropped": float(W - int(avail.sum())),
             "fedsim/straggler_excluded": float(int((avail & straggler).sum())),
             "fedsim/all_dropped": float(n_live == 0),
+            # scheduled preemption request (resilience/guard.py reads it
+            # from the drained-round metrics at round granularity) —
+            # host-side, constant key set, never traced
+            "fedsim/preempt": float(preempt_requested(self.plan, round_idx)),
         }
         return RoundEnv(
             live=live.astype(np.float32),

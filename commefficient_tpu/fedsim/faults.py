@@ -24,6 +24,18 @@ Plan grammar (the ``--chaos`` flag): comma-separated events,
                                    ``DivergenceError`` path fires end to
                                    end — detection needs
                                    ``--telemetry_level >= 1``.
+  * ``nan_client@N:rounds=A-B``  — the counted form: corrupt the first N
+                                   live slots during rounds A..B
+                                   inclusive (``nan_client@1:rounds=5-5``
+                                   == ``nan_client@5``).
+  * ``preempt@R``                — at round R, request a preemption-safe
+                                   shutdown (resilience/guard.py): the
+                                   runner drains metrics, force-saves a
+                                   checkpoint, and exits with the
+                                   distinct resilience.EXIT_PREEMPTED
+                                   code — the deterministic, seeded twin
+                                   of a real SIGTERM, so the e2e test is
+                                   not timing-dependent.
 
 Example: ``--chaos "dropout@0.3:rounds=50-100,nan_client@120"``.
 
@@ -31,6 +43,14 @@ Parsing is syntax-and-range validated here (``utils.config`` calls
 ``parse_chaos`` lazily at construction); round indices against the RUN
 LENGTH are validated by ``validate_chaos_rounds`` at train-entry time,
 because only the train loop knows ``steps_per_epoch * num_epochs``.
+
+Transient-fault semantics (resilience/): a ``nan_client`` injection
+models a transient flake — it fires on a round's FIRST execution only.
+``apply_chaos(..., replay=True)`` (a round re-executed after a
+divergence rollback) suppresses it, which is what lets
+``--recover_policy retry`` heal the run with a bit-identical replay; the
+dropout/straggler draws consume the same rng stream either way, so
+replayed masks stay bit-identical to the first pass.
 """
 
 from __future__ import annotations
@@ -40,7 +60,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-CHAOS_KINDS = ("dropout", "straggler", "nan_client")
+CHAOS_KINDS = ("dropout", "straggler", "nan_client", "preempt")
 
 _GRAMMAR = (
     'comma-separated "kind@value[:rounds=A-B]" with kind in '
@@ -51,9 +71,12 @@ _GRAMMAR = (
 @dataclasses.dataclass(frozen=True)
 class ChaosEvent:
     kind: str  # one of CHAOS_KINDS
-    value: float  # probability (dropout/straggler); the round (nan_client)
+    # probability (dropout/straggler); the round (nan_client@R/preempt@R);
+    # the client count (the counted nan_client@N:rounds=A-B form)
+    value: float
     start: int  # first active round, inclusive
     end: Optional[int]  # last active round inclusive; None = open-ended
+    count: int = 1  # corrupted clients per active round (nan_client only)
 
     def active(self, round_idx: int) -> bool:
         return self.start <= round_idx and (
@@ -98,19 +121,27 @@ def parse_chaos(spec: str) -> Tuple[ChaosEvent, ...]:
             if start < 0 or (end is not None and end < start):
                 raise _fail(spec, f"rounds={rng_s!r} is not an ascending "
                                   "non-negative range")
-        if kind == "nan_client":
+        count = 1
+        if kind == "nan_client" and opt:
+            # counted form: value is the CLIENT COUNT, rounds= the window
+            if value < 1 or value != int(value):
+                raise _fail(spec, f"nan_client@{val_s}:rounds=A-B takes a "
+                                  "client count >= 1 before the rounds "
+                                  "window")
+            count = int(value)
+        elif kind in ("nan_client", "preempt"):
             if opt:
-                raise _fail(spec, "nan_client@R names its round directly; "
+                raise _fail(spec, f"{kind}@R names its round directly; "
                                   "it takes no rounds= option")
             if value < 0 or value != int(value):
-                raise _fail(spec, f"nan_client@{val_s} must name a "
+                raise _fail(spec, f"{kind}@{val_s} must name a "
                                   "non-negative integer round")
             start = end = int(value)
         else:
             if not 0.0 <= value < 1.0:
                 raise _fail(spec, f"{kind} probability {value} outside "
                                   "[0, 1)")
-        events.append(ChaosEvent(kind, value, start, end))
+        events.append(ChaosEvent(kind, value, start, end, count))
     return tuple(events)
 
 
@@ -140,19 +171,29 @@ def apply_chaos(
     rng: np.random.Generator,
     round_idx: int,
     avail: np.ndarray,
+    *,
+    replay: bool = False,
 ):
     """Realize one round's chaos draws on top of ``avail`` (bool [W]).
 
     Returns ``(avail, straggler, corrupt)`` bool masks: ``avail`` with any
     chaos dropout applied, deadline-missing stragglers (drawn among ALL
     slots, meaningful only where available), and the corrupted-payload
-    slot. Draws happen in plan order from the shared round rng, so the
-    realization is a pure function of (seed, round_idx, plan)."""
+    slots (the first live ``count`` of the active nan events). Draws
+    happen in plan order from the shared round rng, so the realization is
+    a pure function of (seed, round_idx, plan).
+
+    ``replay=True`` (a round re-executed after a resilience/ rollback)
+    suppresses the nan_client injection — the transient-fault semantics
+    documented in the module docstring — without consuming any extra rng
+    draws, so dropout/straggler masks stay bit-identical to the first
+    pass. ``preempt`` events never touch the masks (they are realized by
+    ``preempt_requested`` below)."""
     W = avail.shape[0]
     avail = avail.copy()
     straggler = np.zeros(W, bool)
     corrupt = np.zeros(W, bool)
-    want_nan = False
+    want_nan = 0
     for ev in plan:
         if not ev.active(round_idx):
             continue
@@ -160,10 +201,23 @@ def apply_chaos(
             avail &= rng.random(W) >= ev.value
         elif ev.kind == "straggler":
             straggler |= rng.random(W) < ev.value
-        elif ev.kind == "nan_client":
-            want_nan = True
+        elif ev.kind == "nan_client" and not replay:
+            want_nan += ev.count
     if want_nan:
         live = np.flatnonzero(avail & ~straggler)
         if live.size:  # a fully-dropped round has no payload to corrupt
-            corrupt[live[0]] = True
+            corrupt[live[:want_nan]] = True
     return avail, straggler, corrupt
+
+
+def preempt_requested(plan: Tuple[ChaosEvent, ...], round_idx: int) -> bool:
+    """True iff a ``preempt`` event is active at ``round_idx`` — consumed
+    by the resilience/ PreemptGuard via the round's ``fedsim/preempt``
+    stat (host-side; never traced)."""
+    return any(ev.kind == "preempt" and ev.active(round_idx) for ev in plan)
+
+
+def has_preempt(plan: Tuple[ChaosEvent, ...]) -> bool:
+    """True iff the plan schedules any preemption — one of the
+    resilience/ construction gates (build_resilience)."""
+    return any(ev.kind == "preempt" for ev in plan)

@@ -122,6 +122,23 @@ class FederatedSession:
         # restore re-syncs it via sync_round_clock).
         self.fedsim_env = build_environment(cfg)
         self._round_clock = 0
+        # resilience/ replay horizon: rounds below it have EXECUTED in
+        # this process before — a rollback rewinds the round clock but
+        # never the horizon, so a re-executed round realizes its fedsim
+        # env with replay=True (transient nan_client injections fire on
+        # first execution only; see fedsim/faults.py). A fresh process
+        # (checkpoint resume included) starts at 0: it re-executes
+        # nothing, so every round is a first execution here.
+        self._replay_horizon = 0
+        # resilience/ client blacklist (recover_policy='skip_clients'):
+        # sorted unique client ids masked out of every future round's
+        # participation via the SAME pre-device_encode live mask fedsim
+        # applies — None until blacklist_clients is first called.
+        self._client_blacklist = None
+        # resilience rider (resilience/manager.py): attached by
+        # build_resilience at train-entry time; None keeps every round on
+        # the untouched fast path (no resilience/* scalars assembled).
+        self.resilience = None
         # retrace sentinel (telemetry/xla_audit.py): counts traces of the
         # jitted round via the builders' trace_hook — pure python at trace
         # time, zero traced ops, so the compiled program is bit-identical
@@ -670,15 +687,71 @@ class FederatedSession:
         cost otherwise (one scalar fetch, once per restore)."""
         self._round_clock = int(jax.device_get(self.state.step))
 
-    def _fedsim_round_env(self, env=None):
+    def blacklist_clients(self, client_ids) -> np.ndarray:
+        """Add ``client_ids`` to the session blacklist
+        (resilience/policy.py skip_clients): blacklisted clients are
+        masked out of every future round's live mask BEFORE
+        ``device_encode`` — the same ``jnp.where`` gate fedsim's
+        participation mask rides, so unbiasedness over the surviving
+        cohort is preserved by linearity and the server renormalizes by
+        the reduced live count. Returns the cumulative blacklist.
+        Requires a fedsim session (without one the round traced no
+        masking and the blacklist would be silently inert)."""
+        if self.fedsim_env is None:
+            raise ValueError(
+                "blacklist_clients needs a fedsim session (the round must "
+                "have traced masking — cfg.fedsim_enabled); this session "
+                "was built without it"
+            )
+        ids = np.unique(np.asarray(client_ids, np.int64))
+        if self._client_blacklist is not None:
+            ids = np.union1d(self._client_blacklist, ids)
+        self._client_blacklist = ids
+        return ids
+
+    def _blacklist_env(self, env, client_ids):
+        """Compose the session blacklist into one round's RoundEnv:
+        blacklisted LIVE slots drop out (their category moves to
+        dropped — the server neither accepts their uplink nor serves
+        their downlink), the live count and the ``fedsim/*`` stats the
+        ledger bills from re-derive from the reduced mask. Slots already
+        dead stay whatever they were."""
+        bl = np.isin(np.asarray(client_ids, np.int64),
+                     self._client_blacklist)
+        hit = bl & (env.live > 0)
+        n_hit = int(hit.sum())
+        if n_hit == 0:
+            return env
+        live = env.live.copy()
+        live[hit] = 0.0
+        n_live = float(live.sum())
+        stats = dict(env.stats)
+        stats["fedsim/participation_rate"] = n_live / live.shape[0]
+        stats["fedsim/dropped"] = (
+            float(stats.get("fedsim/dropped", 0.0)) + n_hit
+        )
+        stats["fedsim/all_dropped"] = float(n_live == 0)
+        return env._replace(
+            live=live.astype(np.float32),
+            live_count=np.float32(n_live),
+            stats=stats,
+        )
+
+    def _fedsim_round_env(self, env=None, client_ids=None):
         """(device env tuple for round_fn, host ``fedsim/*`` stats) for the
         CURRENT round — ``((), {})`` when the simulator is inactive.
         ``env`` (a fedsim.RoundEnv) overrides the session environment's
-        schedule; tests drive explicit masks through it."""
+        schedule; tests drive explicit masks through it (the pipelined
+        engine passes its prefetched realizations the same way).
+        ``client_ids`` (host [W]) lets the resilience blacklist compose
+        into the mask — trace-only callers (prewarm/audit) may omit it."""
         if env is None:
             if self.fedsim_env is None:
                 return (), {}
-            env = self.fedsim_env.round_env(self._round_clock)
+            env = self.fedsim_env.round_env(
+                self._round_clock,
+                replay=self._round_clock < self._replay_horizon,
+            )
         elif self.fedsim_env is None:
             # symmetric guard to the round's "fedsim enabled but no env"
             # error: a session built without fedsim traced NO masking, so
@@ -690,6 +763,8 @@ class FederatedSession:
                 "masking); construct the Config with availability/chaos "
                 "set to drive masked rounds"
             )
+        if self._client_blacklist is not None and client_ids is not None:
+            env = self._blacklist_env(env, client_ids)
         live = jax.device_put(jnp.asarray(env.live), self._batch_sharding)
         corr = jax.device_put(jnp.asarray(env.corrupt), self._batch_sharding)
         cnt = jax.device_put(jnp.float32(env.live_count), self._replicated)
@@ -707,14 +782,17 @@ class FederatedSession:
 
     def _host_round_stats(self, fs_stats: dict) -> dict:
         """Host scalars riding this round's metric dict: the fedsim stats,
-        (level >= 1) the retrace sentinel's count, and the controller's
-        ``control/*`` scalars — constant key set across an epoch, as
-        pack_metric_dicts requires."""
+        (level >= 1) the retrace sentinel's count, the controller's
+        ``control/*`` scalars, and the resilience rider's ``resilience/*``
+        scalars — constant key set across an epoch, as pack_metric_dicts
+        requires."""
         stats = dict(fs_stats)
         if self.cfg.telemetry_level >= 1:
             stats["xla/retraces"] = float(self.retrace_sentinel.retraces)
         if self.controller is not None:
             stats.update(self.controller.scalars())
+        if self.resilience is not None:
+            stats.update(self.resilience.scalars())
         return stats
 
     def _control_round_start(self, fs_stats: dict) -> None:
@@ -730,7 +808,7 @@ class FederatedSession:
             cids, idxd, pl = self.stage_round_indices(client_ids, idx, plan)
             ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         with self._span("fedsim_env"):
-            fs_env, fs_stats = self._fedsim_round_env(env)
+            fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
         with self._span("round_dispatch") as sp:
             self.state, metrics = self._round_idx_fn(
@@ -740,6 +818,7 @@ class FederatedSession:
             if sp is not None:
                 sp.fence(metrics["loss"])
         self._round_clock += 1
+        self._replay_horizon = max(self._replay_horizon, self._round_clock)
         stats = self._host_round_stats(fs_stats)
         return {**metrics, **stats} if stats else metrics
 
@@ -751,7 +830,7 @@ class FederatedSession:
             ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         lr = jnp.float32(lr)
         with self._span("fedsim_env"):
-            fs_env, fs_stats = self._fedsim_round_env(env)
+            fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
         if not self.cfg.offload_client_state:
             with self._span("round_dispatch") as sp:
@@ -761,6 +840,8 @@ class FederatedSession:
                 if sp is not None:
                     sp.fence(metrics["loss"])
             self._round_clock += 1
+            self._replay_horizon = max(self._replay_horizon,
+                                       self._round_clock)
             stats = self._host_round_stats(fs_stats)
             return {**metrics, **stats} if stats else metrics
         vel_rows = (
@@ -780,6 +861,7 @@ class FederatedSession:
             if sp is not None:
                 sp.fence(metrics["loss"])
         self._round_clock += 1
+        self._replay_horizon = max(self._replay_horizon, self._round_clock)
         if self.host_vel is not None:
             self.host_vel[cids] = np.asarray(new_vel)
         if self.host_err is not None:

@@ -89,27 +89,53 @@ class PipelinedRounds:
         self._occupancy_sum = 0.0
         self._host_ms_sum = 0.0
         self.quiesces = 0
+        self.restarts = 0  # resilience recovery fences (restart())
         if session.controller is not None:
             session.controller.add_switch_listener(self._on_rung_switch)
 
     # -- lifecycle ---------------------------------------------------------
+    def _build_prefetcher(self, start_step: int) -> RoundPrefetcher:
+        return RoundPrefetcher(
+            session=self.session,
+            sampler=self._sampler,
+            lr_fn=self._lr_fn,
+            depth=self.depth,
+            start_step=int(start_step),
+            stop_step=self.num_rounds,
+            microbatches=getattr(self.cfg, "round_microbatches", 0),
+            use_indices=self._use_idx,
+            spans=self.spans,
+            # rounds the session has already executed realize as replays
+            # (transient chaos suppressed) — 0 on a fresh start, the
+            # session's horizon after a recovery restart
+            replay_until=getattr(self.session, "_replay_horizon", 0),
+        ).start()
+
     def start(self, resume_step: int = 0) -> "PipelinedRounds":
         """Start the run-long prefetcher at ``resume_step`` (the global
         round the loop will dispatch next — a resumed run's restored
         step). Idempotent per engine; call once before the epoch loop."""
         if self._prefetcher is None:
-            self._prefetcher = RoundPrefetcher(
-                session=self.session,
-                sampler=self._sampler,
-                lr_fn=self._lr_fn,
-                depth=self.depth,
-                start_step=int(resume_step),
-                stop_step=self.num_rounds,
-                microbatches=getattr(self.cfg, "round_microbatches", 0),
-                use_indices=self._use_idx,
-                spans=self.spans,
-            ).start()
+            self._prefetcher = self._build_prefetcher(resume_step)
         return self
+
+    def restart(self, step: int) -> None:
+        """Resilience recovery fence: the in-flight window staged FUTURE
+        rounds of a trajectory a rollback just rewound, so — exactly like
+        a checkpoint fence — quiesce it (stop + join the worker, drop the
+        staged work) and restage from ``step``, the rollback target. The
+        replayed rounds realize their envs with replay=True via the
+        session's horizon, and the new window dispatches through the same
+        prewarmed programs (zero retraces)."""
+        if self._prefetcher is None:
+            raise RuntimeError("PipelinedRounds.restart before start()")
+        self._prefetcher.close()
+        self._prefetcher = self._build_prefetcher(step)
+        self.restarts += 1
+        if self.spans is not None:
+            with self.spans.span(f"pipeline_recovery_restart:round{step}",
+                                 step=int(step)):
+                pass
 
     def close(self) -> None:
         """Stop + join the prefetch worker (crash paths included — the

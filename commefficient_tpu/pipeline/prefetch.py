@@ -87,7 +87,7 @@ class RoundPrefetcher:
     def __init__(self, *, session, sampler, lr_fn, depth: int,
                  start_step: int = 0, stop_step: int = 0,
                  microbatches: int = 0, use_indices: bool = False,
-                 spans=None):
+                 spans=None, replay_until: int = 0):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.session = session
@@ -96,6 +96,12 @@ class RoundPrefetcher:
         self.depth = int(depth)
         self.start_step = int(start_step)
         self.stop_step = int(stop_step)
+        # resilience/ replay fence: rounds below it re-execute after a
+        # divergence rollback, so their fedsim envs realize with
+        # replay=True (transient nan_client injections suppressed —
+        # fedsim/faults.py). The engine passes the session's replay
+        # horizon when it restarts the window after a recovery.
+        self.replay_until = int(replay_until)
         self.microbatches = int(microbatches)
         self.use_indices = bool(use_indices)
         self.spans = spans
@@ -135,7 +141,8 @@ class RoundPrefetcher:
                         for k, v in batch.items()
                     }
                 idx = plan = None
-            env = (sess.fedsim_env.round_env(step)
+            env = (sess.fedsim_env.round_env(
+                       step, replay=step < self.replay_until)
                    if sess.fedsim_env is not None else None)
             lr = float(self.lr_fn(step))
         with self._span("prefetch_stage", step):

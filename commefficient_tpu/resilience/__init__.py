@@ -1,0 +1,87 @@
+"""Self-healing training — terminal failures become bounded recoveries.
+
+FetchSGD (arXiv:2007.07682) targets long federated runs over untrusted
+client payloads, and local-update robustness work (arXiv:1903.04488)
+assumes a fault-tolerant outer loop — yet before this package every
+failure in this stack was terminal: a chaos ``nan_client`` injection
+killed the run through ``DivergenceError`` (the PR 3/4 story proves the
+run *dies* cleanly, not that it *survives*), a SIGTERM between
+checkpoints lost up to ``checkpoint_every`` rounds, and a truncated
+latest checkpoint made restore fail with no fallback. Production
+training loops recover; this package makes ours, in three pillars:
+
+  * ``vault``   — ``RollbackVault``: in-memory/host-side FedState
+    snapshots (params, momentum, error, comp, controller blob, host
+    client rows, ledger counters — ``_to_saveable``'s structure, never a
+    disk round-trip) every ``--snapshot_every`` rounds. Each snapshot is
+    preceded by a metric drain, and the drain IS the divergence check,
+    so every snapshot the vault admits is certified finite — the
+    rollback target is always pre-divergence by construction.
+  * ``policy``  — the pluggable recovery registry (the compress/ and
+    control/ discipline; ``--recover_policy``): ``retry`` replays
+    bit-identically (fedsim's transient-fault semantics suppress the
+    nan_client injection on replay, so a recovered retry run matches the
+    uninterrupted run bit-exactly), ``demote`` floors the control/
+    ladder one rung cheaper through the AOT-prewarmed switch path (zero
+    retraces), ``skip_clients`` blacklists the bad round's suspect
+    client ids from every future participation mask (composed with the
+    fedsim live mask before ``device_encode``; unbiasedness preserved by
+    linearity, renormalized by the live count).
+  * ``guard``   — ``PreemptGuard``: SIGTERM/SIGINT riders (and the
+    seeded ``preempt@R`` chaos twin) that the runner checks at round
+    granularity; a request drains pending metrics, force-saves a
+    checkpoint, writes ledger/flight/spans, and exits with the distinct
+    ``EXIT_PREEMPTED`` code so orchestrators can tell "preempted, resume
+    me" from "crashed".
+
+``manager.RecoveryManager``/``ResilienceRider`` wire the pillars into
+``train/runner.py`` exactly once. Recoveries exhausted
+(``--max_recoveries``) re-raise the ORIGINAL ``DivergenceError`` with the
+full recovery history attached; every recovery also lands in telemetry
+(``resilience/*`` scalars, schema v6) and in the flight recorder's
+``recovery_history`` block.
+
+``--recover_policy none`` with no preemption source constructs NOTHING —
+the ``telemetry_level 0`` / ``availability='always'`` /
+``control_policy='none'`` gate discipline: the compiled round, the golden
+``registry_parity.npz`` recordings and the level-0 HLO stay bit-untouched,
+and no signal handler is installed.
+
+Layering: host-side logic over utils/ (checkpoint leaf commit), fedsim/
+(replay semantics), control/ (demotion) and telemetry/ (detection +
+reporting) hooks; ``train/runner.py`` imports this package. Recovery-
+policy string dispatch lives in ``policy.py`` (and utils/config.py flag
+validation) ONLY — enforced by scripts/check_mode_dispatch.py.
+"""
+
+from commefficient_tpu.resilience.guard import (
+    EXIT_PREEMPTED,
+    PreemptGuard,
+    PreemptShutdown,
+)
+from commefficient_tpu.resilience.manager import (
+    RecoveryManager,
+    ResilienceRider,
+    build_resilience,
+)
+from commefficient_tpu.resilience.policy import (
+    POLICIES,
+    RecoveryUnavailable,
+    available_recover_policies,
+    get_recovery_policy,
+)
+from commefficient_tpu.resilience.vault import RollbackVault
+
+__all__ = [
+    "EXIT_PREEMPTED",
+    "POLICIES",
+    "PreemptGuard",
+    "PreemptShutdown",
+    "RecoveryManager",
+    "RecoveryUnavailable",
+    "ResilienceRider",
+    "RollbackVault",
+    "available_recover_policies",
+    "build_resilience",
+    "get_recovery_policy",
+]

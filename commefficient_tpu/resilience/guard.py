@@ -1,0 +1,121 @@
+"""PreemptGuard — preemption-safe shutdown, checked at round granularity.
+
+A preempted TPU slice (or a ctrl-C'd dev run) used to lose everything
+since the last ``checkpoint_every`` boundary. The guard turns the two
+preemption sources into ONE flag the runner polls once per round:
+
+  * OS signals — SIGTERM/SIGINT riders installed iff
+    ``--preempt_signals`` (default off: no handler is installed and the
+    previous disposition is restored on close, so test harnesses and
+    embedding processes are never surprised). The handler only sets the
+    flag — everything heavy (drain, checkpoint, artifact writes) happens
+    on the main thread at the next round boundary, where the device
+    state is consistent.
+  * the fedsim chaos event ``preempt@R`` — the DETERMINISTIC twin: the
+    round's ``fedsim/preempt`` stat (a host scalar riding the metric
+    dict) requests the same shutdown, so the e2e test is seeded, not
+    timing-dependent.
+
+On a request the runner drains pending metrics, force-saves a checkpoint
+(``maybe_save(force=True)``), lets the normal crash machinery write the
+flight record / ledger / spans, and raises ``PreemptShutdown``; the train
+entries convert it to the distinct exit code ``EXIT_PREEMPTED`` (75,
+sysexits' EX_TEMPFAIL) so an orchestrator can tell "preempted — resume
+me" from "crashed — investigate". ``--resume`` from the forced
+checkpoint reproduces the uninterrupted run bit-exactly (the standard
+resume contract; tests/test_resilience.py pins it).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional
+
+# sysexits EX_TEMPFAIL: "temporary failure, retry later" — exactly what a
+# preempted-but-checkpointed run is. Distinct from 0 (done) and 1 (crash).
+EXIT_PREEMPTED = 75
+
+
+class PreemptShutdown(RuntimeError):
+    """Raised by the runner after a preemption request was honored:
+    metrics drained, a checkpoint force-saved at round ``step`` (when
+    checkpointing is configured — ``saved`` says whether one exists, and
+    the message never claims a checkpoint that was not written), artifact
+    writers flushed by the normal teardown. Train entries exit with
+    ``EXIT_PREEMPTED`` either way: the preemption is still a temporary
+    failure, just not a resumable one without a checkpoint_dir."""
+
+    def __init__(self, step: int, source: Optional[str],
+                 saved: bool = True):
+        self.step = int(step)
+        self.source = source or "unknown"
+        self.saved = bool(saved)
+        if self.saved:
+            what = (f"drained metrics and force-saved a checkpoint at "
+                    f"round {self.step} — rerun with --resume to continue "
+                    "bit-exactly")
+        else:
+            what = (f"drained metrics at round {self.step} but NO "
+                    "checkpoint was saved (checkpointing is disabled — "
+                    "set --checkpoint_dir to make preemption resumable); "
+                    "a rerun starts from round 0")
+        super().__init__(
+            f"preemption requested ({self.source}); {what} "
+            f"(exit code {EXIT_PREEMPTED})"
+        )
+
+
+class PreemptGuard:
+    """The shared shutdown flag. Safe to construct anywhere; only
+    ``install_signals=True`` touches process-global signal state (and
+    ``close`` restores it)."""
+
+    def __init__(self, install_signals: bool = False):
+        self.requested = False
+        self.source: Optional[str] = None
+        self._installed = []
+        if install_signals:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev = signal.signal(sig, self._on_signal)
+                except (ValueError, OSError):
+                    # not the main thread / unsupported platform: degrade
+                    # to the chaos/explicit request paths rather than die
+                    continue
+                self._installed.append((sig, prev))
+
+    @property
+    def signals_installed(self) -> bool:
+        return bool(self._installed)
+
+    def _on_signal(self, signum, frame) -> None:
+        # flag only — no I/O, no device calls: the runner does the real
+        # work at the next round boundary on the main thread
+        self.request(f"signal {signal.Signals(signum).name}")
+
+    def request(self, source: str) -> None:
+        """Set the flag (idempotent; the first source wins)."""
+        if not self.requested:
+            self.requested = True
+            self.source = source
+
+    def check_metrics(self, metrics) -> bool:
+        """Fold one round's metric dict into the flag: the fedsim
+        ``preempt@R`` chaos event rides as the host scalar
+        ``fedsim/preempt``. Returns the (possibly updated) flag. Never
+        forces a device sync — the scalar is host-side by construction."""
+        if not self.requested and metrics:
+            v = metrics.get("fedsim/preempt", 0.0)
+            if isinstance(v, (int, float)) and float(v) > 0.0:
+                self.request("chaos preempt@round")
+        return self.requested
+
+    def close(self) -> None:
+        """Restore the previous signal dispositions (runner finally
+        block — crash paths included)."""
+        for sig, prev in self._installed:
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._installed = []

@@ -1,0 +1,184 @@
+"""Recovery policies — what to CHANGE after a divergence rollback.
+
+The registry discipline of compress/ and control/: each policy is one
+class behind ``POLICIES``, keyed by the ``--recover_policy`` flag, and
+recovery-policy string dispatch happens here (and in utils/config.py flag
+validation) ONLY — scripts/check_mode_dispatch.py enforces the boundary.
+
+Every policy runs host-side, AFTER the vault restored the pre-divergence
+snapshot (so ``demote`` migrates the RESTORED state down the ladder, not
+the diverged garbage) and BEFORE the runner re-enters the round loop.
+``apply`` returns a jsonable details dict for the recovery-history entry,
+or raises ``RecoveryUnavailable`` when the policy cannot act — the
+manager then aborts the recovery and the original ``DivergenceError``
+re-raises with the history attached.
+
+  * ``retry``        — change nothing: the replay itself is the repair.
+                       fedsim's transient-fault semantics suppress the
+                       ``nan_client`` injection on re-executed rounds, so
+                       a healed retry run is BIT-IDENTICAL to the
+                       uninterrupted (chaos-free) run — the determinism
+                       contract tests/test_resilience.py pins.
+  * ``demote``       — ``BudgetController.demote``: floor the control/
+                       compression ladder one rung cheaper and switch now,
+                       through the AOT-prewarmed ``set_active_rung`` +
+                       ``migrate_state`` path (never a retrace). An honest
+                       fork: the recovered run is NOT the uninterrupted
+                       one and says so in its history entry.
+  * ``skip_clients`` — blacklist the bad round's suspect client ids
+                       (the chaos-corrupted slots when the realization
+                       names them, else every live participant of that
+                       round) from all future participation masks via
+                       ``FederatedSession.blacklist_clients``. Also an
+                       honest fork; unbiasedness over the SURVIVING
+                       cohort is preserved by mask linearity + live-count
+                       renormalization (the fedsim contract).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class RecoveryUnavailable(RuntimeError):
+    """The selected policy cannot act on this session/failure (e.g. a
+    demotion with no cheaper rung left, a corrupt round whose suspects
+    cannot be named). The manager aborts the recovery and re-raises the
+    original DivergenceError."""
+
+
+class RecoveryPolicy:
+    """One ``--recover_policy`` entry. Stateless; the manager owns the
+    counters/history."""
+
+    name = "?"
+    # True for policies whose apply() mutates session state the replay
+    # itself would not reproduce (a demotion floor, a blacklist): the
+    # runner then re-saves the rollback checkpoint so a crash before the
+    # next boundary resumes WITH the fork. retry changes nothing, so its
+    # replay re-creates any discarded checkpoints bit-identically.
+    forks = False
+
+    def check(self, session, manager, exc, snap) -> None:
+        """Raise RecoveryUnavailable if the policy will not be able to
+        act, WITHOUT side effects — the manager calls this BEFORE the
+        rewind (vault restore, ledger counters, flight ring), so an
+        aborted recovery dies with its teardown artifacts (comm_ledger,
+        crash flight dump) still describing what actually ran. ``snap``
+        is the rollback target the restore WOULD use."""
+
+    def apply(self, session, manager, exc) -> Optional[Dict]:
+        """Act on ``session`` after the rollback; ``exc`` is the caught
+        DivergenceError (``exc.step`` = first bad round). Returns jsonable
+        action details for the history entry; raises RecoveryUnavailable
+        when the policy cannot act."""
+        raise NotImplementedError
+
+
+class RetryPolicy(RecoveryPolicy):
+    name = "retry"
+
+    def apply(self, session, manager, exc) -> Optional[Dict]:
+        # the bit-identical replay IS the repair (transient-fault
+        # semantics suppress the injection on re-execution)
+        return {"action": "retry"}
+
+
+class DemotePolicy(RecoveryPolicy):
+    name = "demote"
+    forks = True
+
+    def check(self, session, manager, exc, snap) -> None:
+        import numpy as np
+
+        controller = getattr(session, "controller", None)
+        if controller is None:
+            raise RecoveryUnavailable(
+                "recover_policy='demote' needs the control/ ladder, but "
+                "this session has no controller"
+            )
+        # the rung the restore will re-activate (vault.restore reads the
+        # same blob slot) — unavailable iff it is already the cheapest
+        top = len(session.rungs) - 1
+        restored = session.active_rung
+        if snap is not None and snap.control is not None:
+            saved = int(np.asarray(snap.control)[1])
+            if 0 <= saved <= top:
+                restored = saved
+        # the demotion floor is monotone across blob loads (it survives a
+        # rollback to a pre-demotion snapshot), so the rung apply() will
+        # descend FROM is the restored rung clamped to the floor
+        restored = max(restored, int(getattr(controller, "min_rung", 0)))
+        if restored >= top:
+            raise RecoveryUnavailable(
+                f"already at the cheapest rung ({top}) — no rung left "
+                "to demote to"
+            )
+
+    def apply(self, session, manager, exc) -> Optional[Dict]:
+        controller = getattr(session, "controller", None)
+        if controller is None:
+            raise RecoveryUnavailable(
+                "recover_policy='demote' needs the control/ ladder, but "
+                "this session has no controller"
+            )
+        before = session.active_rung
+        after = controller.demote(exc.step)
+        if after == before:
+            raise RecoveryUnavailable(
+                f"already at the cheapest rung ({before}) — no rung left "
+                "to demote to"
+            )
+        manager.rung_demotions += 1
+        return {"action": "demote", "from_rung": int(before),
+                "to_rung": int(after)}
+
+
+class SkipClientsPolicy(RecoveryPolicy):
+    name = "skip_clients"
+    forks = True
+
+    def check(self, session, manager, exc, snap) -> None:
+        # suspect_clients is pure (and memoized per step), so the check
+        # costs nothing extra over the apply
+        if manager.suspect_clients(exc.step).size == 0:
+            raise RecoveryUnavailable(
+                f"round {exc.step} has no suspect clients to blacklist "
+                "(no live participants realized for it)"
+            )
+
+    def apply(self, session, manager, exc) -> Optional[Dict]:
+        suspects = manager.suspect_clients(exc.step)
+        if suspects.size == 0:
+            raise RecoveryUnavailable(
+                f"round {exc.step} has no suspect clients to blacklist "
+                "(no live participants realized for it)"
+            )
+        session.blacklist_clients(suspects)
+        return {"action": "skip_clients",
+                "blacklisted": [int(c) for c in suspects]}
+
+
+POLICIES = {
+    "retry": RetryPolicy,
+    "demote": DemotePolicy,
+    "skip_clients": SkipClientsPolicy,
+}
+
+
+def available_recover_policies() -> tuple:
+    """Registered policy names + the 'none' gate, sorted — pinned equal
+    to config.RECOVER_POLICIES by tests/test_mode_dispatch.py."""
+    return tuple(sorted(set(POLICIES) | {"none"}))
+
+
+def get_recovery_policy(cfg) -> RecoveryPolicy:
+    """The single recover_policy dispatch point (never called for
+    'none' — build_resilience gates on cfg.recovery_enabled first)."""
+    cls = POLICIES.get(cfg.recover_policy)
+    if cls is None:
+        raise ValueError(
+            f"unknown recover_policy {cfg.recover_policy!r}; registered: "
+            f"{available_recover_policies()}"
+        )
+    return cls()

@@ -1,0 +1,165 @@
+"""RollbackVault — drain-certified in-memory FedState snapshots.
+
+A divergence is detected at DRAIN time (telemetry/flight.py check), up to
+a drain interval after the first bad round — so a recovery needs a state
+image from strictly before that round, without paying a disk round-trip
+per boundary. The vault keeps the last few snapshots host-side, in
+exactly ``utils.checkpoint._to_saveable``'s structure (params vector,
+momentum/error/comp leaves, step, host-offloaded client rows, the
+controller blob) plus the CommLedger's counters, and restores them
+through the same ``commit_fed_state`` leaf-commit path checkpoint restore
+uses — FSDP shards go back to their P(workers) shardings, replicated
+leaves to the replicated sharding, so a post-rollback round dispatches
+the SAME prewarmed program (zero retraces).
+
+The certainty argument the runner leans on: it drains immediately before
+every ``snapshot()`` call, drains check divergence in step order, and a
+raising drain never reaches the snapshot — therefore every snapshot in
+the vault covers only rounds certified finite, and the newest snapshot
+with ``step <= first_bad_step`` always exists (the baseline snapshot at
+the start round seeds the vault before any boundary).
+
+Capturing a snapshot fetches the device state (``np.asarray`` blocks on
+the in-flight round) — a deliberate sync point, paid only when
+``--recover_policy`` is on, at ``--snapshot_every`` granularity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """One drain-certified state image at a round boundary: the state the
+    run had BEFORE round ``step`` dispatched."""
+
+    step: int
+    fed_state: Dict[str, Any]  # field -> host np.ndarray | ()
+    host_vel: Optional[np.ndarray]
+    host_err: Optional[np.ndarray]
+    control: Optional[np.ndarray]  # controller state blob (float64)
+    ledger: Optional[dict]  # CommLedger.snapshot_state()
+    captured_at: float  # wall clock, forensics only
+    # opaque host-side rider the runner attaches at capture time (e.g.
+    # the epoch metric accumulator) and reads back after a rollback —
+    # the vault stores it verbatim, so the caller passes copies
+    extras: Optional[Dict[str, Any]] = None
+
+    @property
+    def nbytes(self) -> int:
+        out = sum(
+            a.nbytes for a in self.fed_state.values()
+            if isinstance(a, np.ndarray)
+        )
+        for a in (self.host_vel, self.host_err, self.control):
+            if a is not None:
+                out += a.nbytes
+        return out
+
+
+class RollbackVault:
+    """Ring of the last ``keep`` snapshots, one every ``snapshot_every``
+    rounds (plus the explicit baseline the runner seeds at its start
+    round)."""
+
+    def __init__(self, snapshot_every: int, keep: int = 2):
+        if snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {snapshot_every}"
+            )
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.snapshot_every = int(snapshot_every)
+        self.keep = int(keep)
+        self._snaps: deque = deque(maxlen=self.keep)
+        self.captures = 0
+        self.restores = 0
+
+    def __len__(self) -> int:
+        return len(self._snaps)
+
+    def will_snapshot(self, step: int) -> bool:
+        """True iff the runner should drain-then-snapshot at round
+        boundary ``step`` (the checkpoint ``will_save`` discipline)."""
+        return step > 0 and step % self.snapshot_every == 0
+
+    def snapshot(self, session, step: int, ledger=None,
+                 extras: Optional[Dict[str, Any]] = None) -> Snapshot:
+        """Capture the session's full federated state at boundary
+        ``step``. Re-snapshotting an existing boundary (a replayed round
+        window after a rollback) replaces that entry in place."""
+        from commefficient_tpu.utils.checkpoint import _to_saveable
+
+        saveable = _to_saveable(session)
+        fs = {
+            f: (v if isinstance(v, tuple) else np.asarray(v).copy())
+            for f, v in saveable["fed_state"].items()
+        }
+        snap = Snapshot(
+            step=int(step),
+            fed_state=fs,
+            # the session mutates host rows IN PLACE each round — copies,
+            # not views, or the snapshot would silently track the live run
+            host_vel=(None if session.host_vel is None
+                      else np.array(session.host_vel, copy=True)),
+            host_err=(None if session.host_err is None
+                      else np.array(session.host_err, copy=True)),
+            control=(np.asarray(saveable["control"]).copy()
+                     if "control" in saveable else None),
+            ledger=(ledger.snapshot_state() if ledger is not None else None),
+            captured_at=time.time(),
+            extras=extras,
+        )
+        self.captures += 1
+        if self._snaps and self._snaps[-1].step == snap.step:
+            self._snaps[-1] = snap
+        else:
+            self._snaps.append(snap)
+        return snap
+
+    def latest(self, max_step: Optional[int] = None) -> Optional[Snapshot]:
+        """The newest snapshot at/before ``max_step`` (None = newest)."""
+        for snap in reversed(self._snaps):
+            if max_step is None or snap.step <= max_step:
+                return snap
+        return None
+
+    def restore(self, session, snap: Snapshot, ledger=None) -> int:
+        """Rewind ``session`` (and ``ledger``) to ``snap`` in place;
+        returns the snapshot's step. Mirrors checkpoint restore's order:
+        the saved rung activates first (dispatch swap only — the
+        snapshot's leaves are already in its layout), then the leaves
+        re-commit to their mesh shardings, then the controller counters
+        load."""
+        from commefficient_tpu.utils.checkpoint import commit_fed_state
+
+        controller = getattr(session, "controller", None)
+        if controller is not None and snap.control is not None:
+            saved_rung = int(np.asarray(snap.control)[1])
+            if 0 <= saved_rung < len(session.rungs):
+                session.set_active_rung(saved_rung, migrate=False)
+        session.state = commit_fed_state(
+            session, snap.fed_state,
+            origin=f"rollback snapshot at round {snap.step}",
+        )
+        if snap.host_vel is not None:
+            session.host_vel = np.array(snap.host_vel, copy=True)
+        if snap.host_err is not None:
+            session.host_err = np.array(snap.host_err, copy=True)
+        if controller is not None and snap.control is not None:
+            controller.load_state_blob(snap.control)
+        if ledger is not None and snap.ledger is not None:
+            ledger.load_snapshot_state(snap.ledger)
+        # the fedsim availability/chaos schedule keys off the host round
+        # clock mirroring FedState.step — re-sync, exactly like a
+        # checkpoint restore (the replay horizon is deliberately NOT
+        # touched: rounds below it re-run with replay=True semantics)
+        session.sync_round_clock()
+        self.restores += 1
+        return snap.step

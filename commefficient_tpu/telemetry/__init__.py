@@ -104,7 +104,15 @@ from commefficient_tpu.telemetry.xla_audit import (
 # invariants checker-enforced), and thread-aware spans: per-event lane
 # ``tid``s plus "M" thread_name metadata events labeling the prefetch
 # lane's own track.
-SCHEMA_VERSION = 5
+# v6 (self-healing training PR): the resilience/* scalar namespace
+# (recoveries / rung_demotions / blacklisted_clients — non-negative
+# integer counters; preempt_requested in {0, 1}; rollback_round an
+# integer >= -1, all checker-enforced host gauges), the flight dump's
+# "recovery_history" block (one entry per divergence rollback: policy,
+# first bad round, rollback target, outcome), the "_recovery"-tagged
+# flight dump written after a successful rollback, and the fedsim/preempt
+# scheduled-preemption stat.
+SCHEMA_VERSION = 6
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

@@ -107,6 +107,22 @@ class FlightRecorder:
         # attributable to a rung switch — the per-record control/rung
         # scalars then give the switch history inside the window
         self.controller = controller
+        # duck-typed resilience rider (resilience/): needs a ``history``
+        # attribute (list of recovery entries). When set and non-empty,
+        # every dump carries the schema-v6 ``recovery_history`` block —
+        # attached post-construction by build_resilience (the riders are
+        # built first, the resilience layer after them).
+        self.resilience = None
+
+    def rewind(self, step: int) -> None:
+        """Resilience rollback: drop ring records at/after ``step`` so the
+        replayed rounds re-record in step order (the dump's increasing-
+        step invariant survives recovery). The diverged pass's trajectory
+        is not lost — its dump was written at detection time, before the
+        rollback."""
+        kept = [r for r in self.records if r["step"] < int(step)]
+        self.records = deque(kept, maxlen=self.window)
+        self.last_step = kept[-1]["step"] if kept else None
 
     def record(self, step: int, lr: float, scalars: dict) -> None:
         self.last_step = int(step)
@@ -145,13 +161,17 @@ class FlightRecorder:
         )
 
     def dump(self, step: int, *, reason: str,
-             first_bad_step: Optional[int]) -> Optional[str]:
+             first_bad_step: Optional[int], tag: str = "") -> Optional[str]:
+        """``tag`` distinguishes sibling dumps for the same step (the
+        resilience manager writes ``flight_<F>_recovery.json`` next to the
+        detection-time ``flight_<F>.json`` instead of overwriting the
+        divergence forensics)."""
         if not self.logdir:
             return None
         from commefficient_tpu.telemetry import SCHEMA_VERSION
 
         os.makedirs(self.logdir, exist_ok=True)
-        path = os.path.join(self.logdir, f"flight_{int(step)}.json")
+        path = os.path.join(self.logdir, f"flight_{int(step)}{tag}.json")
         payload = {
             "schema_version": SCHEMA_VERSION,
             "reason": reason,
@@ -179,6 +199,17 @@ class FlightRecorder:
             # top-level, next to the per-record control/rung trajectory
             try:
                 payload["controller"] = self.controller.snapshot()
+            except Exception:  # noqa: BLE001 — a dump must never fail
+                pass
+        if self.resilience is not None:
+            # recovery attribution (schema v6): every rollback this run
+            # survived — policy, first bad round, rollback target, action
+            # details — so a later crash's post-mortem sees the repaired
+            # past, and the recovery dump itself persists the block
+            try:
+                hist = list(self.resilience.history)
+                if hist:
+                    payload["recovery_history"] = hist
             except Exception:  # noqa: BLE001 — a dump must never fail
                 pass
         with open(path, "w") as f:

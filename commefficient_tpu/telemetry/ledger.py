@@ -169,6 +169,48 @@ class CommLedger:
             "comm/cum_bytes": self.cum_up_bytes + self.cum_down_bytes,
         }
 
+    # -- resilience/ rollback support --------------------------------------
+    def snapshot_state(self) -> dict:
+        """The ledger's mutable counters, host ints only — captured by the
+        resilience RollbackVault at each drain-certified snapshot boundary
+        so a divergence rollback can rewind the accounting: replayed
+        rounds then bill exactly once and the exactness invariant
+        (checker-enforced) survives recovery."""
+        out = {
+            "rounds": self.rounds,
+            "cum_up_bytes": self.cum_up_bytes,
+            "cum_down_bytes": self.cum_down_bytes,
+            "live_client_rounds": self.live_client_rounds,
+            "avail_client_rounds": self.avail_client_rounds,
+        }
+        if self.rungs is not None:
+            out["rungs"] = [
+                {k: r[k] for k in ("rounds", "live_client_rounds",
+                                   "avail_client_rounds")}
+                for r in self.rungs
+            ]
+        return out
+
+    def load_snapshot_state(self, state: dict) -> None:
+        """Rewind to a ``snapshot_state`` capture (resilience rollback)."""
+        self.rounds = int(state["rounds"])
+        self.cum_up_bytes = int(state["cum_up_bytes"])
+        self.cum_down_bytes = int(state["cum_down_bytes"])
+        self.live_client_rounds = int(state["live_client_rounds"])
+        self.avail_client_rounds = int(state["avail_client_rounds"])
+        if self.rungs is not None:
+            saved = state.get("rungs")
+            if saved is None or len(saved) != len(self.rungs):
+                raise ValueError(
+                    "ledger snapshot rung count does not match this "
+                    "ledger's ladder — the snapshot was captured under a "
+                    "different control config"
+                )
+            for rec, s in zip(self.rungs, saved):
+                for k in ("rounds", "live_client_rounds",
+                          "avail_client_rounds"):
+                    rec[k] = int(s[k])
+
     def summary(self) -> dict:
         from commefficient_tpu.telemetry import SCHEMA_VERSION
 

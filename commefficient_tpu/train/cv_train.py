@@ -20,6 +20,15 @@ Run-command parity examples:
       # rank-4 warm-started power iteration, ~320x downlink compression
       # at ResNet-9 scale (see README mode table / compress/powersgd.py)
 
+Failure handling (resilience/; README "Failure handling & recovery"):
+``--recover_policy retry|demote|skip_clients`` turns a chaos- or
+hardware-induced divergence into a bounded rollback-and-recover instead
+of a dead run (``--snapshot_every`` sets the rollback granularity,
+``--max_recoveries`` the give-up bound; needs ``--telemetry_level >= 1``);
+``--preempt_signals true`` (or the seeded chaos event ``preempt@R``)
+makes SIGTERM/SIGINT a drain + forced checkpoint + exit code 75 instead
+of lost rounds — rerun with ``--resume`` to continue bit-exactly.
+
 Sketch kernels: ``--sketch_backend pallas`` runs the CountSketch matmul
 path as tiled Pallas TPU kernels (ops/pallas/ — in-kernel hashes/signs,
 fused overlap-add; same tables as the default einsum backend to fp32
@@ -238,16 +247,22 @@ def main(argv=None, **overrides):
 
     writer = MetricsWriter(make_logdir(cfg), cfg.tensorboard, cfg=cfg,
                            extra_header=controller_header(session))
+    from commefficient_tpu.resilience import EXIT_PREEMPTED, PreemptShutdown
     from commefficient_tpu.utils.checkpoint import FedCheckpointer
 
     checkpointer = FedCheckpointer(cfg)
     try:
+        # the shared runner owns both the end-of-training force-save and
+        # the crash-path checkpointer close; the close here is the
+        # idempotent belt for pre-loop failures
         val = train_loop(cfg, session, sampler, test, writer,
                          checkpointer=checkpointer)
-        if checkpointer.enabled:
-            checkpointer.maybe_save(
-                session, int(session.state.step), force=True
-            )
+    except PreemptShutdown as e:
+        # preemption-safe shutdown (resilience/): metrics drained and a
+        # checkpoint force-saved by the runner — exit with the DISTINCT
+        # code so orchestrators retry with --resume instead of paging
+        print(str(e))
+        raise SystemExit(EXIT_PREEMPTED) from e
     finally:
         checkpointer.close()
         writer.close()

@@ -35,6 +35,15 @@ Run-command parity examples:
       # Identical tables/estimates to the default einsum backend up to
       # fp32 rounding (checkpoints are backend-portable).
 
+Failure handling (resilience/; README "Failure handling & recovery"):
+long GPT-2 runs are exactly where self-healing pays — ``--recover_policy
+retry|demote|skip_clients`` rolls a divergence back to the last in-memory
+snapshot instead of dying (``demote`` composes with the control/ ladder:
+the run degrades one rung cheaper through the AOT-prewarmed switch, zero
+retraces), and ``--preempt_signals true`` turns a TPU preemption's
+SIGTERM into a drain + forced checkpoint + exit code 75; ``--resume``
+then reproduces the uninterrupted run bit-exactly.
+
 Sketch sizing at GPT-2 scale: keep ``num_cols >= D/25`` (~5M for
 GPT-2-small, ~5x upload compression — the reference's own GPT-2 run
 compresses ~3.9x uplink). The r3 lab measured d/c >= 50 DIVERGING under
@@ -342,11 +351,20 @@ def main(argv=None, **overrides):
         if cfg.checkpoint_dir
         else cfg
     )
+    from commefficient_tpu.resilience import EXIT_PREEMPTED, PreemptShutdown
+
     try:
+        # the shared runner owns the end-of-training force-save and the
+        # crash-path checkpointer close (the close below is idempotent)
         val = train_loop(cfg, session, sampler, test, writer,
                          checkpointer=checkpointer, gcfg=gcfg)
-        if checkpointer.enabled:
-            checkpointer.maybe_save(session, int(session.state.step), force=True)
+    except PreemptShutdown as e:
+        # preemption-safe shutdown (resilience/): drained + force-saved by
+        # the runner; the distinct exit code tells orchestrators to retry
+        # with --resume (the HF-format export below is skipped — the run
+        # is not finished)
+        print(str(e))
+        raise SystemExit(EXIT_PREEMPTED) from e
     finally:
         checkpointer.close()
         writer.close()

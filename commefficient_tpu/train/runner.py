@@ -20,10 +20,26 @@ t+1..t+depth's host work and H2D with round t's device compute. Both
 sources yield the same ``(step, lr, metrics)`` triples to the same drain/
 checkpoint/crash machinery, which is what makes the two execution modes
 bit-exact (tests/test_pipeline.py pins it end to end).
+
+Since the self-healing PR the scaffold also hosts the resilience/ layer,
+wired once for both entries: a ``DivergenceError`` raised by any drain is
+offered to the ``ResilienceRider`` first — a successful rollback restores
+the last drain-certified vault snapshot, restarts the round source at the
+rollback round (the pipelined engine quiesces its prefetch window like a
+checkpoint fence) and re-enters the epoch loop; only an unrecoverable
+divergence (policy 'none', recoveries exhausted, no snapshot) reaches the
+legacy crash path. A preemption request (SIGTERM/SIGINT rider or the
+seeded ``preempt@R`` chaos event) is honored at round granularity: drain,
+``maybe_save(force=True)``, then ``PreemptShutdown`` — which rides the
+normal crash teardown (flight dump, ledger write, spans close) out to the
+entries' distinct ``EXIT_PREEMPTED`` code. ``--recover_policy none``
+with no preemption source constructs NOTHING (README "Failure handling &
+recovery").
 """
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from functools import partial
 from typing import Optional
 
@@ -158,6 +174,18 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
         cfg, session, sampler, writer, float(lr_fn(0)),
         generated_by=generated_by,
     )
+    # self-healing layer (resilience/): None unless a recovery policy or a
+    # preemption source is configured — the default run constructs
+    # NOTHING (no vault, no signal handler, no resilience/* scalars).
+    # Built AFTER the riders (the manager rewinds the ledger and rides the
+    # flight recorder) and BEFORE the restore/engine (the baseline
+    # snapshot must capture the restored state).
+    from commefficient_tpu.resilience import PreemptShutdown, build_resilience
+
+    resil = build_resilience(cfg, session, sampler, ledger=ledger,
+                             flight=flight)
+    if resil is not None:
+        print(resil.describe())
     val = {}
     step = 0
     # the current epoch's drain closure, reachable from the crash handler:
@@ -166,82 +194,214 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
     # flush the ledger/flight would be blind to the crashed epoch's
     # completed rounds
     live_drain = [None]
-    if checkpointer is not None and cfg.resume:
-        restored = checkpointer.restore(session)
-        if restored is not None:
-            step = restored
-            profiler.resume_at(step)  # clamp the trace window post-resume
-            if spans is not None:
-                spans.resume_at(step)
-            print(f"resumed from checkpoint at round {step}")
-    # pipelined round engine (pipeline/): ONLY built at depth >= 1 — the
-    # one place both entries' pipelining is wired. Constructed AFTER the
-    # restore so the prefetcher starts at the resumed step (its inputs
-    # are pure functions of the round index, so the staged stream is the
-    # uninterrupted run's).
     engine = None
-    if cfg.pipeline_enabled:
-        from commefficient_tpu.pipeline import PipelinedRounds
-
-        engine = PipelinedRounds(
-            cfg, session, sampler, lr_fn, num_rounds,
-            steps_per_epoch=steps_per_epoch, spans=spans, profiler=profiler,
-        ).start(step)
-        print(f"pipeline: depth={cfg.pipeline_depth} (host staging + H2D "
-              "overlap device compute; bit-exact vs depth 0)")
     try:
-        for epoch in range(step // steps_per_epoch, cfg.num_epochs):
-            timer()
-            pending = []  # (step, lr, device-metrics); drain_round_metrics
-            acc_state = hooks.new_accumulator()
-
-            def acc(loss, metrics, _a=acc_state):
-                hooks.accumulate(_a, loss, metrics)
-
-            def drain(_acc=acc):
+        if checkpointer is not None and cfg.resume:
+            restored = checkpointer.restore(session)
+            if restored is not None:
+                step = restored
+                profiler.resume_at(step)  # clamp trace window post-resume
                 if spans is not None:
-                    with spans.span("metric_drain"):
-                        drain_round_metrics(pending, writer, _acc,
-                                            ledger=ledger, flight=flight,
-                                            controller=controller)
-                else:
-                    drain_round_metrics(pending, writer, _acc,
-                                        ledger=ledger, flight=flight,
-                                        controller=controller)
+                    spans.resume_at(step)
+                print(f"resumed from checkpoint at round {step}")
+        # pipelined round engine (pipeline/): ONLY built at depth >= 1 —
+        # the one place both entries' pipelining is wired. Constructed
+        # AFTER the restore so the prefetcher starts at the resumed step
+        # (its inputs are pure functions of the round index, so the
+        # staged stream is the uninterrupted run's).
+        if cfg.pipeline_enabled:
+            from commefficient_tpu.pipeline import PipelinedRounds
 
-            live_drain[0] = drain
-            rounds = (
-                engine.epoch_rounds(epoch, step)
-                if engine is not None
-                else _sync_epoch_rounds(cfg, session, sampler, lr_fn, spans,
-                                        profiler, epoch, step,
-                                        steps_per_epoch)
-            )
-            lr = float(lr_fn(step))
-            for s, lr, metrics in rounds:
-                pending.append((s, lr, metrics))
-                step = s + 1
+            engine = PipelinedRounds(
+                cfg, session, sampler, lr_fn, num_rounds,
+                steps_per_epoch=steps_per_epoch, spans=spans,
+                profiler=profiler,
+            ).start(step)
+            print(f"pipeline: depth={cfg.pipeline_depth} (host staging + "
+                  "H2D overlap device compute; bit-exact vs depth 0)")
+        if resil is not None:
+            # seed the rollback vault at the start round (post-restore): a
+            # divergence before the first snapshot_every boundary is then
+            # still recoverable — back to the very start if need be
+            resil.baseline(step)
+    except BaseException:
+        # a pre-loop failure (restore walk-back exhausted, engine start,
+        # baseline capture) never reaches the finally below — join the
+        # already-started prefetch worker and restore the signal
+        # dispositions before propagating, or a surviving process
+        # (embedding, pytest) leaks the staging thread and keeps
+        # flag-only SIGTERM/SIGINT handlers nobody polls
+        if engine is not None:
+            engine.close()
+        if resil is not None:
+            resil.close()
+        raise
+
+    def span(name):
+        # one shape for every optional-span site (drain / checkpoint /
+        # snapshot) — no-op context when spans are off
+        return spans.span(name) if spans is not None else nullcontext()
+
+    def ckpt_save(force=False):
+        with span("checkpoint"):
+            return checkpointer.maybe_save(session, step, force=force)
+
+    resume_acc = None  # accumulator rider restored by the last rollback
+    # highest epoch whose END block (table row, eval, val scalars,
+    # on_epoch_end) already ran: a rollback can land inside a completed
+    # epoch, and a non-forking (retry) replay must not duplicate those
+    # side effects — the replayed rows would double in the table and
+    # break the healed-run == uninterrupted-run contract. A resume at
+    # step S has completed exactly the epochs below S's (works at exact
+    # boundaries too: S // spe - 1 == the last finished epoch).
+    completed_epoch = step // steps_per_epoch - 1
+    try:
+        while True:  # recovery loop: one iteration per (re-)entry
+            try:
+                for epoch in range(step // steps_per_epoch, cfg.num_epochs):
+                    timer()
+                    pending = []  # (step, lr, device-metrics)
+                    acc_state = hooks.new_accumulator()
+                    if resume_acc is not None and isinstance(acc_state, dict):
+                        # a mid-epoch rollback replays only rounds >= the
+                        # snapshot; the snapshot's accumulator re-seeds
+                        # the rounds before it, so the epoch row still
+                        # averages the FULL epoch (and a healed retry
+                        # run's table matches the uninterrupted one)
+                        acc_state.clear()
+                        acc_state.update(resume_acc)
+                    resume_acc = None
+
+                    def acc(loss, metrics, _a=acc_state):
+                        hooks.accumulate(_a, loss, metrics)
+
+                    def drain(_acc=acc):
+                        with span("metric_drain"):
+                            drain_round_metrics(pending, writer, _acc,
+                                                ledger=ledger, flight=flight,
+                                                controller=controller)
+
+                    live_drain[0] = drain
+                    rounds = (
+                        engine.epoch_rounds(epoch, step)
+                        if engine is not None
+                        else _sync_epoch_rounds(cfg, session, sampler, lr_fn,
+                                                spans, profiler, epoch, step,
+                                                steps_per_epoch)
+                    )
+                    lr = float(lr_fn(step))
+                    for s, lr, metrics in rounds:
+                        pending.append((s, lr, metrics))
+                        step = s + 1
+                        if checkpointer is not None:
+                            if checkpointer.will_save(step):
+                                drain()
+                            ckpt_save()
+                        if resil is not None and resil.will_snapshot(step):
+                            # the drain certifies rounds < step finite (it
+                            # IS the divergence check) BEFORE the vault
+                            # admits the snapshot — the checkpoint
+                            # will_save-then-save discipline
+                            drain()
+                            with span("snapshot"):
+                                # the epoch accumulator rides the snapshot
+                                # (host copy) so a rollback here can
+                                # re-seed it for the replayed tail
+                                resil.snapshot(
+                                    step,
+                                    extras=({"acc": dict(acc_state)}
+                                            if isinstance(acc_state, dict)
+                                            else None),
+                                )
+                        if (resil is not None
+                                and resil.preempt_requested(metrics)):
+                            # preemption-safe shutdown at round
+                            # granularity: flush everything this round
+                            # included, force a checkpoint, then let the
+                            # crash teardown write flight/ledger/spans
+                            drain()
+                            # a boundary the loop JUST saved dedups the
+                            # force-save to False — a checkpoint at this
+                            # exact step still exists, so the message's
+                            # --resume promise holds
+                            saved = bool(checkpointer is not None
+                                         and (ckpt_save(force=True)
+                                              or checkpointer.latest_step()
+                                              == step))
+                            if writer:
+                                writer.scalar("resilience/preempt_requested",
+                                              1.0, s)
+                                writer.flush()
+                            raise PreemptShutdown(step, resil.preempt_source,
+                                                  saved=saved)
+                    drain()
+                    train_time = timer()
+                    if epoch > completed_epoch:
+                        val = hooks.evaluate()
+                        val_time = timer()
+                        table.append(hooks.epoch_row(
+                            epoch=epoch, lr=lr, acc=acc_state, val=val,
+                            train_time=train_time, val_time=val_time,
+                            steps_per_epoch=steps_per_epoch,
+                        ))
+                        if writer:
+                            hooks.write_val(writer, val, step)
+                            writer.flush()
+                        hooks.on_epoch_end(epoch, val)
+                    completed_epoch = max(completed_epoch, epoch)
+                break  # clean completion of the epoch loop
+            except DivergenceError as e:
+                # divergence rollback-and-recover (resilience/): restore
+                # the last drain-certified snapshot and re-enter the loop
+                # there; None -> unrecoverable, fall through to the legacy
+                # crash path with e.recovery_history attached
+                rollback = (resil.on_divergence(e)
+                            if resil is not None else None)
+                if rollback is None:
+                    raise
+                step = rollback
+                # re-seed the epoch accumulator only when the rollback
+                # lands MID-epoch: a boundary snapshot's accumulator
+                # covers the epoch that just finished, and a fresh epoch
+                # correctly starts from zeros
+                extras = resil.last_restored_extras or {}
+                resume_acc = (extras.get("acc")
+                              if step % steps_per_epoch else None)
+                if resil.manager.policy.forks:
+                    # a forking recovery (demote/skip_clients) changes the
+                    # replayed trajectory: re-run the end blocks of any
+                    # re-trained epoch so the table/val scalars report the
+                    # fork honestly (retry keeps them skipped — its replay
+                    # is bit-identical, re-reporting would only duplicate)
+                    completed_epoch = min(completed_epoch,
+                                          step // steps_per_epoch - 1)
                 if checkpointer is not None:
-                    if checkpointer.will_save(step):
-                        drain()
-                    if spans is not None:
-                        with spans.span("checkpoint"):
-                            checkpointer.maybe_save(session, step)
-                    else:
-                        checkpointer.maybe_save(session, step)
-            drain()
-            train_time = timer()
-            val = hooks.evaluate()
-            val_time = timer()
-            table.append(hooks.epoch_row(
-                epoch=epoch, lr=lr, acc=acc_state, val=val,
-                train_time=train_time, val_time=val_time,
-                steps_per_epoch=steps_per_epoch,
-            ))
-            if writer:
-                hooks.write_val(writer, val, step)
-                writer.flush()
-            hooks.on_epoch_end(epoch, val)
+                    # checkpoints above the rollback came from the
+                    # rolled-back trajectory: drop them so the replay's
+                    # own saves land (a demote/skip_clients fork would
+                    # otherwise leave a stale pre-recovery state for a
+                    # later --resume)
+                    checkpointer.discard_steps_after(step)
+                    if resil.manager.policy.forks:
+                        # a forking recovery mutated state every retained
+                        # checkpoint predates (the demotion floor / the
+                        # blacklist): persist it NOW, or a crash before
+                        # the next boundary resumes without the fork
+                        checkpointer.resave(session, step)
+                if engine is not None:
+                    engine.restart(step)  # quiesce + restage the window
+                m = resil.manager
+                print(f"resilience: recovered from divergence at round "
+                      f"{e.step} — rolled back to round {step} under "
+                      f"policy {cfg.recover_policy!r} "
+                      f"(recovery {m.recoveries}/{m.max_recoveries})")
+        # end-of-training checkpoint: a run that completes round
+        # num_rounds would otherwise leave its last
+        # num_rounds % checkpoint_every rounds unsaved and --resume on a
+        # finished run would re-train them (the epoch-end drain above
+        # already flushed everything this save covers)
+        if checkpointer is not None:
+            ckpt_save(force=True)
     except Exception as e:
         # best-effort flush of the crashed epoch's completed rounds so the
         # ledger totals and the flight ring cover them (a flush-time
@@ -267,6 +427,14 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
         if ledger is not None:
             # partial ledgers are still evidence — write on crash too
             ledger.write(writer.logdir)
+        if checkpointer is not None:
+            # close alongside profiler/spans/ledger: the Orbax manager
+            # used to leak on crash paths when only the entries' own
+            # finally closed it (close() is idempotent, so an entry-level
+            # close after this one is a no-op)
+            checkpointer.close()
+        if resil is not None:
+            resil.close()  # restore signal dispositions (crash paths too)
     if not val:
         # resumed at/after the final round (the epoch loop never ran):
         # still evaluate so callers get final metrics instead of a KeyError

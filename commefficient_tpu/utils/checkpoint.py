@@ -13,7 +13,10 @@ full training clock — resume reproduces the uninterrupted run bit-for-bit
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import warnings
 from typing import Optional
 
 import jax
@@ -62,7 +65,68 @@ def _to_saveable(session) -> dict:
         # (drains happen before saves, so the blob reflects every drained
         # round <= this checkpoint's step)
         out["control"] = session.controller.state_blob()
+    if getattr(session, "_client_blacklist", None) is not None:
+        # resilience/ skip_clients blacklist: session-cumulative and
+        # monotone, so a resumed run must keep masking the clients a
+        # recovery already condemned — without this leaf a preempt/resume
+        # cycle would silently re-admit them
+        out["blacklist"] = np.asarray(session._client_blacklist, np.int64)
     return out
+
+
+def _sha256_file(path: str) -> str:
+    """Chunked file digest shared by manifest write and verify — one
+    idiom, so a chunk-size or algorithm change can't desync the two."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def commit_fed_state(session, fs: dict, *, origin: str = "checkpoint") -> FedState:
+    """Re-commit a host-side fed_state leaf dict to ``session``'s mesh
+    shardings and return the new FedState — shared by checkpoint restore
+    and the resilience RollbackVault (resilience/vault.py), so the two
+    in-place state-replacement paths can never drift.
+
+    FSDP leaves go back to their P(workers) shards (a plain asarray would
+    park the full padded state on ONE device — the exact memory wall FSDP
+    removes), replicated-round leaves to the replicated sharding (else the
+    donated round_fn compiles a second program against the
+    SingleDeviceSharding layout, see FederatedSession.__init__). A leaf
+    absent from ``fs`` (pre-PR2 checkpoints: no ``comp``) keeps the
+    session's freshly initialized value, with a warning naming ``origin``.
+    """
+    if session.cfg.fsdp:
+        from commefficient_tpu.parallel.fsdp import fsdp_state_shardings
+
+        shardings = fsdp_state_shardings(session.cfg, session.mesh)
+    else:
+        shardings = FedState(*[session._replicated] * len(FedState._fields))
+    leaves = {}
+    for f in FedState._fields:
+        if f not in fs:
+            # legacy source with no compressor warm state — keep the
+            # session's freshly initialized leaf (legacy modes: (); a
+            # powersgd session restores everything else and restarts its
+            # Q warm-up cold).
+            leaves[f] = getattr(session.state, f)
+            if not isinstance(leaves[f], tuple):
+                warnings.warn(
+                    f"{origin} predates the compressor warm-state leaf "
+                    f"{f!r}; restored everything else and re-initialized "
+                    "it (powersgd warm start restarts cold — one extra "
+                    "power iteration of subspace tracking)."
+                )
+            continue
+        leaves[f] = (
+            () if isinstance(fs[f], (tuple, list)) and len(fs[f]) == 0
+            else jax.device_put(
+                jax.numpy.asarray(fs[f]), getattr(shardings, f)
+            )
+        )
+    return FedState(**leaves)
 
 
 class FedCheckpointer:
@@ -96,8 +160,16 @@ class FedCheckpointer:
         return force or (every > 0 and round_idx > 0 and round_idx % every == 0)
 
     def maybe_save(self, session, round_idx: int, *, force: bool = False) -> bool:
-        """Save if ``checkpoint_every`` divides ``round_idx`` (or forced)."""
+        """Save if ``checkpoint_every`` divides ``round_idx`` (or forced).
+        A step already on disk is never re-saved (the runner's
+        end-of-training force-save may land on a boundary the loop
+        already wrote). Every save also writes an integrity manifest
+        sidecar (sizes + sha256 per file) that ``restore`` verifies —
+        a truncated/corrupted step is then rejected with its reason
+        instead of restored as garbage."""
         if not self.will_save(round_idx, force=force):
+            return False
+        if self.mngr.latest_step() == round_idx:
             return False
         import orbax.checkpoint as ocp
 
@@ -105,10 +177,126 @@ class FedCheckpointer:
             round_idx, args=ocp.args.StandardSave(_to_saveable(session))
         )
         self.mngr.wait_until_finished()
+        self._write_manifest(round_idx)
         return True
 
     def latest_step(self) -> Optional[int]:
         return self.mngr.latest_step() if self.enabled else None
+
+    def discard_steps_after(self, step: int) -> None:
+        """Resilience rollback support: retained checkpoints ABOVE the
+        rollback step were saved from the rolled-back trajectory. A
+        ``retry`` replay reproduces them bit-identically, but ``demote``/
+        ``skip_clients`` fork — leaving the old step on disk would make
+        the replay's ``maybe_save`` at that boundary a silent no-op and a
+        later ``--resume`` restore a PRE-recovery state (stale rung floor
+        / blacklist). Delete them so the replay re-saves its own."""
+        if not self.enabled:
+            return
+        for s in sorted(int(x) for x in (self.mngr.all_steps() or [])):
+            if s > int(step):
+                self.mngr.delete(s)
+        self.mngr.wait_until_finished()
+        self._gc_manifests()
+
+    def resave(self, session, step: int) -> bool:
+        """Persist the CURRENT session state at ``step``, replacing any
+        retained checkpoint there. Used after a FORKING recovery
+        (demote/skip_clients): the rollback restored round ``step``'s
+        params, but the policy then mutated session state the retained
+        blob predates (the demotion floor, the blacklist) — a crash
+        before the next boundary would otherwise ``--resume`` without
+        the fork. No-op when checkpointing is off."""
+        if not self.enabled:
+            return False
+        if int(step) in {int(s) for s in (self.mngr.all_steps() or [])}:
+            self.mngr.delete(int(step))
+            self.mngr.wait_until_finished()
+        return self.maybe_save(session, int(step), force=True)
+
+    # -- integrity manifests (resilience: checkpoint fallback) -------------
+    def _root(self) -> str:
+        return os.path.abspath(self.cfg.checkpoint_dir)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._root(), str(int(step)))
+
+    def _manifest_path(self, step: int) -> str:
+        # sidecars live OUTSIDE the orbax step dirs (an extra file inside
+        # one could be mistaken for an item); GC'd alongside rotation
+        return os.path.join(self._root(), "manifests", f"{int(step)}.json")
+
+    def _write_manifest(self, step: int) -> Optional[str]:
+        """Hash every file of the committed step into
+        ``<dir>/manifests/<step>.json`` (atomic write), and drop sidecars
+        of rotated-away steps. Best-effort: a manifest failure must not
+        kill the save (the checkpoint itself is already durable; restore
+        just loses pre-verification for this step)."""
+        try:
+            step_dir = self._step_dir(step)
+            files = {}
+            for dirpath, _dirs, fnames in os.walk(step_dir):
+                for fn in sorted(fnames):
+                    p = os.path.join(dirpath, fn)
+                    files[os.path.relpath(p, step_dir)] = {
+                        "size": os.path.getsize(p),
+                        "sha256": _sha256_file(p),
+                    }
+            path = self._manifest_path(step)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": int(step), "files": files}, f, indent=2)
+            os.replace(tmp, path)
+            self._gc_manifests()
+            return path
+        except Exception as e:  # noqa: BLE001 — observability, not data
+            warnings.warn(
+                f"checkpoint manifest for step {step} not written "
+                f"({type(e).__name__}: {e}); restore will skip integrity "
+                "verification for this step"
+            )
+            return None
+
+    def _gc_manifests(self) -> None:
+        mdir = os.path.join(self._root(), "manifests")
+        if not os.path.isdir(mdir):
+            return
+        retained = {int(s) for s in (self.mngr.all_steps() or [])}
+        for fn in os.listdir(mdir):
+            stem, ext = os.path.splitext(fn)
+            if ext == ".json" and stem.isdigit() and int(stem) not in retained:
+                try:
+                    os.remove(os.path.join(mdir, fn))
+                except OSError:
+                    pass
+
+    def verify_step(self, step: int) -> Optional[str]:
+        """Integrity-check the on-disk step against its manifest sidecar.
+        Returns None when consistent (or when no sidecar exists — a
+        legacy checkpoint has nothing to verify against), else a
+        human-readable rejection reason naming the first mismatch."""
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except Exception as e:  # noqa: BLE001 — a bad sidecar IS a reason
+            return f"unreadable manifest sidecar ({type(e).__name__}: {e})"
+        step_dir = self._step_dir(step)
+        for rel, info in sorted(files.items()):
+            p = os.path.join(step_dir, rel)
+            if not os.path.exists(p):
+                return f"missing file {rel!r}"
+            size = os.path.getsize(p)
+            if size != info["size"]:
+                return (f"size mismatch at {rel!r} ({size} B on disk, "
+                        f"manifest says {info['size']} B)")
+            if _sha256_file(p) != info["sha256"]:
+                return f"sha256 mismatch at {rel!r}"
+        return None
 
     def _saved_lacks_sketch_layout(self, step: int, exc: Exception) -> bool:
         """True if the on-disk checkpoint at ``step`` predates the r4
@@ -176,7 +364,7 @@ class FedCheckpointer:
         import orbax.checkpoint as ocp
 
         template = {**template, "fed_state": dict(template["fed_state"])}
-        for _ in range(3):  # at most: full, -control, -comp
+        for _ in range(4):  # at most: full, ±blacklist, -control, -comp
             try:
                 return self.mngr.restore(
                     step, args=ocp.args.StandardRestore(template)
@@ -185,6 +373,17 @@ class FedCheckpointer:
                 msg = str(e)
                 if "Dict key mismatch" not in msg:
                     raise
+                if "blacklist" in msg:
+                    if "blacklist" in template:
+                        # checkpoint predates (or never had) a blacklist:
+                        # the session keeps its own
+                        template.pop("blacklist")
+                    else:
+                        # checkpoint CARRIES a blacklist this fresh
+                        # session doesn't know yet — restore it (shape
+                        # comes from the saved array)
+                        template["blacklist"] = np.zeros(0, np.int64)
+                    continue
                 if "control" in template and "control" in msg:
                     # pre-control checkpoint into a controlled session:
                     # restore the rest; the controller starts at its
@@ -210,6 +409,54 @@ class FedCheckpointer:
         """Restore into ``session`` in place; returns the restored round
         index (== FedState.step) or None if nothing to restore.
 
+        Integrity fallback (resilience pillar 3): with ``step=None`` the
+        walk starts at the latest retained step, pre-verifies it against
+        its manifest sidecar, and on a mismatch — or ANY restore failure —
+        falls back to the next older retained step with a warning naming
+        the rejected step and the reason, only failing when the whole
+        vault is exhausted (the final error chains every per-step
+        failure). An EXPLICIT ``step`` is restored strictly: the caller
+        named it, so a bad step raises instead of silently substituting
+        an older one."""
+        if not self.enabled:
+            return None
+        if step is not None:
+            bad = self.verify_step(step)
+            if bad is not None:
+                raise ValueError(
+                    f"checkpoint at step {step} failed integrity "
+                    f"verification: {bad}"
+                )
+            return self._restore_step(session, step)
+        steps = sorted((s for s in (self.mngr.all_steps() or [])),
+                       reverse=True)
+        if not steps:
+            return None
+        failures = []
+        last_exc: Optional[Exception] = None
+        for n, s in enumerate(steps):
+            older = len(steps) - n - 1
+            reason = self.verify_step(s)
+            if reason is None:
+                try:
+                    return self._restore_step(session, s)
+                except Exception as e:  # noqa: BLE001 — walk back
+                    reason = f"{type(e).__name__}: {e}"
+                    last_exc = last_exc or e
+            failures.append((s, reason))
+            warnings.warn(
+                f"checkpoint at step {s} REJECTED ({reason})"
+                + (f"; falling back to the next of {older} older retained "
+                   "step(s)" if older else "; no older retained steps left")
+            )
+        raise ValueError(
+            "restore failed at every retained checkpoint step — "
+            + "; ".join(f"step {s}: {r}" for s, r in failures)
+        ) from last_exc
+
+    def _restore_step(self, session, step: int) -> int:
+        """One step's restore (the pre-fallback restore semantics).
+
         Controlled sessions (control/ ladder): the checkpointed server
         state is laid out for the rung ACTIVE at save time, which a
         shape-changing ladder (num_cols/powersgd_rank) may make differ
@@ -217,15 +464,10 @@ class FedCheckpointer:
         rung layouts until one matches, then the restored ``control``
         blob re-activates the exact saved rung and policy state, so the
         resumed run reproduces the uninterrupted rung sequence."""
-        if not self.enabled:
-            return None
-        step = step if step is not None else self.mngr.latest_step()
-        if step is None:
-            return None
-
         candidates = self._rung_template_candidates(session)
         try:
             restored = None
+            attempts = []  # (template label, exception) per failed layout
             for n, cand in enumerate(candidates):
                 if cand is not None and cand != session.active_rung:
                     # rebuild the template in rung ``cand``'s layout; the
@@ -237,9 +479,28 @@ class FedCheckpointer:
                         step, _to_saveable(session)
                     )
                     break
-                except Exception:  # noqa: BLE001 — try the next layout
+                except Exception as exc:  # noqa: BLE001 — try next layout
+                    label = ("base template" if cand is None
+                             else f"rung {cand} template")
+                    attempts.append((label, exc))
                     if n == len(candidates) - 1:
-                        raise
+                        if len(attempts) == 1:
+                            raise
+                        # every candidate failed: name EACH attempt and
+                        # chain the FIRST (the active-rung template is
+                        # tried first and is the likely save-time layout —
+                        # a genuine corruption error there must not be
+                        # masked by a later layout's shape mismatch)
+                        raise ValueError(
+                            "restore failed under every rung state "
+                            "template — "
+                            + "; ".join(
+                                f"{lab}: {type(e).__name__}: {e}"
+                                for lab, e in attempts
+                            )
+                            + " (the first attempt's failure is chained "
+                            "as the cause)"
+                        ) from attempts[0][1]
         except Exception as e:  # noqa: BLE001 — re-raise with provenance
             if session.spec is not None and self._saved_lacks_sketch_layout(
                 step, e
@@ -291,44 +552,12 @@ class FedCheckpointer:
                 f"{session.grad_size} — wrong model/config for this checkpoint"
             )
         fs = restored["fed_state"]
-        # Re-commit every leaf to its mesh sharding: FSDP leaves go back to
-        # their P(workers) shards (a plain asarray would park the full
-        # padded state on ONE device — the exact memory wall FSDP removes),
-        # replicated-round leaves to the replicated sharding (else the
-        # donated round_fn compiles a second program against the
-        # SingleDeviceSharding layout, see FederatedSession.__init__).
-        if session.cfg.fsdp:
-            from commefficient_tpu.parallel.fsdp import fsdp_state_shardings
-
-            shardings = fsdp_state_shardings(session.cfg, session.mesh)
-        else:
-            shardings = FedState(*[session._replicated] * len(FedState._fields))
-        leaves = {}
-        for f in FedState._fields:
-            if f not in fs:
-                # pre-PR2 checkpoint: no compressor warm state on disk —
-                # keep the session's freshly initialized leaf (legacy
-                # modes: (); a powersgd session restores everything else
-                # and restarts its Q warm-up cold).
-                leaves[f] = getattr(session.state, f)
-                if not isinstance(leaves[f], tuple):
-                    import warnings
-
-                    warnings.warn(
-                        f"checkpoint at step {step} predates the "
-                        f"compressor warm-state leaf {f!r}; restored "
-                        "everything else and re-initialized it (powersgd "
-                        "warm start restarts cold — one extra power "
-                        "iteration of subspace tracking)."
-                    )
-                continue
-            leaves[f] = (
-                () if isinstance(fs[f], (tuple, list)) and len(fs[f]) == 0
-                else jax.device_put(
-                    jax.numpy.asarray(fs[f]), getattr(shardings, f)
-                )
-            )
-        session.state = FedState(**leaves)
+        # shared leaf-commit path (also the resilience RollbackVault's):
+        # every leaf back onto its mesh sharding, missing legacy leaves
+        # kept fresh with a warning
+        session.state = commit_fed_state(
+            session, fs, origin=f"checkpoint at step {step}"
+        )
         if "host_vel" in restored:
             session.host_vel = np.asarray(restored["host_vel"])
         if "host_err" in restored:
@@ -342,8 +571,6 @@ class FedCheckpointer:
                 # uninterrupted run's
                 session.controller.load_state_blob(restored["control"])
             else:
-                import warnings
-
                 warnings.warn(
                     f"checkpoint at step {step} predates the adaptive-"
                     "communication controller; restored everything else — "
@@ -351,6 +578,14 @@ class FedCheckpointer:
                     "spend), so the resumed rung sequence is NOT the "
                     "uninterrupted run's"
                 )
+        if "blacklist" in restored:
+            # resilience/ skip_clients: re-condemn the clients a recovery
+            # blacklisted before the save — blacklist_clients validates
+            # the session can actually mask them (fedsim), so a config
+            # mismatch fails loudly instead of silently re-admitting them
+            bl = np.asarray(restored["blacklist"], np.int64).ravel()
+            if bl.size:
+                session.blacklist_clients(bl)
         # the fedsim availability/chaos schedule keys off a host round
         # clock mirroring FedState.step — re-sync it so a resumed run
         # realizes the SAME masks the uninterrupted run would have
@@ -358,5 +593,10 @@ class FedCheckpointer:
         return int(np.asarray(fs["step"]))
 
     def close(self):
-        if self.enabled:
+        """Release the Orbax manager. Idempotent: the shared runner closes
+        it in its ``finally`` block (crash paths included), and the train
+        entries' own ``finally`` may close again — the second call is a
+        no-op, not a double-close error."""
+        if self.mngr is not None:
             self.mngr.close()
+            self.mngr = None

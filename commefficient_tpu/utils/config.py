@@ -26,6 +26,10 @@ AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "sine")
 # mirrors the control/ policy registry (control.CONTROL_POLICIES); pinned
 # equal by tests/test_control.py — same no-cycle pattern as MODES
 CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
+# mirrors the resilience/ recovery-policy registry (resilience.policy
+# POLICIES); pinned equal by tests/test_mode_dispatch.py — same no-cycle
+# pattern as MODES/CONTROL_POLICIES
+RECOVER_POLICIES = ("none", "retry", "demote", "skip_clients")
 
 
 @dataclass(frozen=True)
@@ -371,6 +375,45 @@ class Config:
     # oscillate every round (tests/test_control.py pins the property).
     control_hysteresis: int = 8
 
+    # --- self-healing training (commefficient_tpu/resilience/;
+    # TPU-native — the reference treats every failure as terminal) ---
+    # Divergence recovery policy: "none" (default — NOTHING resilience-
+    # related is constructed; the telemetry_level-0 discipline, golden
+    # parity and level-0 HLO bit-untouched), "retry" (roll back to the
+    # last vault snapshot and replay bit-identically — heals transient
+    # faults; a recovered run matches the uninterrupted one bit-exactly),
+    # "demote" (roll back AND floor the control/ ladder one rung cheaper
+    # via the AOT-prewarmed switch path — needs a >= 2-rung ladder),
+    # "skip_clients" (roll back AND blacklist the bad round's suspect
+    # client ids from all future participation masks — needs fedsim;
+    # unbiasedness preserved by linearity, renormalized by live count).
+    # Detection rides the flight recorder, so != "none" needs
+    # --telemetry_level >= 1. Recoveries exhausted (--max_recoveries) ->
+    # the original DivergenceError re-raises with the recovery history
+    # attached. See README "Failure handling & recovery".
+    recover_policy: str = "none"
+    # Rounds between in-memory rollback snapshots (resilience/vault.py):
+    # each snapshot is preceded by a metric drain, so every snapshot in
+    # the vault is certified finite (the divergence check runs in the
+    # drain) and the rollback target is always pre-divergence. The vault
+    # retains the last two snapshots host-side (~2x the FedState bytes of
+    # host RAM); a baseline snapshot at the start round makes recovery
+    # possible before the first boundary. Active iff recover_policy is
+    # not "none".
+    snapshot_every: int = 16
+    # Recoveries before the run gives up and re-raises the original
+    # DivergenceError (with the full recovery history attached). A
+    # genuinely deterministic divergence replays identically under
+    # "retry", so this bound is what terminates that loop.
+    max_recoveries: int = 2
+    # Install SIGTERM/SIGINT riders that request a preemption-safe
+    # shutdown at round granularity: drain pending metrics, force-save a
+    # checkpoint, write ledger/flight/spans, exit with the distinct code
+    # resilience.EXIT_PREEMPTED (75). Off by default (no handler is
+    # installed — constructs nothing). The fedsim chaos event
+    # "preempt@R" injects the same request deterministically for tests.
+    preempt_signals: bool = False
+
     # --- misc (reference: --seed; the mesh-shape flags above are ours) ---
     seed: int = 42
     checkpoint_dir: str = ""
@@ -551,6 +594,60 @@ class Config:
                 f"{self.pipeline_depth}"
             )
         self._validate_control()
+        self._validate_resilience()
+
+    def _validate_resilience(self) -> None:
+        """Self-healing flags (resilience/). Same late-validation split as
+        control/: grammar/shape here, anything needing the run length or
+        the realized session at train-entry/build time."""
+        if self.recover_policy not in RECOVER_POLICIES:
+            raise ValueError(
+                f"recover_policy must be one of {RECOVER_POLICIES}, got "
+                f"{self.recover_policy!r}"
+            )
+        if self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1 round, got "
+                f"{self.snapshot_every}"
+            )
+        if self.max_recoveries < 1:
+            raise ValueError(
+                f"max_recoveries must be >= 1, got {self.max_recoveries} "
+                "(use recover_policy='none' to disable recovery entirely)"
+            )
+        if self.recover_policy == "none":
+            return
+        if self.telemetry_level < 1:
+            raise ValueError(
+                f"recover_policy={self.recover_policy!r} recovers from the "
+                "flight recorder's DivergenceError, which only fires at "
+                "--telemetry_level >= 1 (the in-graph non-finite sentinel "
+                "+ drain-time check) — at level 0 a divergence is never "
+                "detected, so the policy would silently never act"
+            )
+        if self.recover_policy == "demote":
+            if not self.control_enabled or not self.ladder:
+                raise ValueError(
+                    "recover_policy='demote' descends the control/ "
+                    "compression ladder — configure a controller with a "
+                    'ladder (e.g. --control_policy fixed --ladder '
+                    '"k=60000,30000")'
+                )
+            from commefficient_tpu.control.ladder import parse_ladder
+
+            if len(parse_ladder(self.ladder)) < 2:
+                raise ValueError(
+                    "recover_policy='demote' needs a ladder with >= 2 "
+                    "rungs to demote between"
+                )
+        if self.recover_policy == "skip_clients" and not self.fedsim_enabled:
+            raise ValueError(
+                "recover_policy='skip_clients' masks blacklisted clients "
+                "through the fedsim participation mask, but this config "
+                "traces no masking (availability='always', no chaos) — "
+                "enable fedsim (e.g. --availability bernoulli) or pick "
+                "another policy"
+            )
 
     def _validate_control(self) -> None:
         """Adaptive-communication-budget flags (control/). Grammar/shape
@@ -684,6 +781,16 @@ class Config:
         single-rung and bit-identical to a pre-control build — the golden
         parity recordings pin that (control/ package docstring)."""
         return self.control_policy != "none"
+
+    @property
+    def recovery_enabled(self) -> bool:
+        """True when the divergence rollback-and-recover machinery must be
+        built (resilience/ vault + manager). False keeps the train loop on
+        the untouched fast path with nothing resilience-related
+        constructed — the fedsim/control/pipeline gate discipline. (The
+        preemption guard has its own gate: ``preempt_signals`` or a
+        ``preempt@R`` chaos event.)"""
+        return self.recover_policy != "none"
 
     @property
     def pipeline_enabled(self) -> bool:

@@ -78,6 +78,12 @@ LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
 HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
 HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
                              "_samples_per_sec", "_occupancy")
+# resilience/control PRs: every *_retraces leg gauge is a hard invariant,
+# not a throughput — the AOT-prewarm contract says rung switches and
+# rollback restores never retrace, so ANY non-zero value fails outright
+# (no history or tolerance involved; a relative band on an
+# all-zero trajectory would divide by zero anyway)
+EXACT_ZERO_SUFFIXES = ("_retraces",)
 
 
 def metric_direction(name: str):
@@ -131,6 +137,19 @@ def check_regression(history, latest, default_tolerance=DEFAULT_TOLERANCE):
             continue
         comparable.append(h)
     for name, v in sorted(latest.items()):
+        if (name.endswith(EXACT_ZERO_SUFFIXES)
+                and isinstance(v, (int, float)) and not isinstance(v, bool)):
+            if v != 0:
+                regressions.append({
+                    "metric": name,
+                    "direction": "exact_zero",
+                    "latest": v,
+                    "baseline_median": 0,
+                    "bound": 0,
+                    "tolerance": 0.0,
+                    "n_prior": len(comparable),
+                })
+            continue
         direction = metric_direction(name)
         if direction is None or not isinstance(v, (int, float)) \
                 or isinstance(v, bool):

@@ -2,10 +2,12 @@
 
 The compress/ registry refactor (PR 2) moved every mode's algebra behind
 ``compress.get_compressor``; the control/ subsystem (PR 8) did the same
-for rung-selection policies behind ``control.policy.get_policy``. The
-invariant that keeps a new compressor (or policy) a one-file PR is that
-NOBODY else branches on the registry's key strings. This script walks the
-``commefficient_tpu`` package ASTs and fails on any
+for rung-selection policies behind ``control.policy.get_policy``; the
+resilience/ subsystem (PR 10) for recovery policies behind
+``resilience.policy.get_recovery_policy``. The invariant that keeps a new
+compressor (or policy) a one-file PR is that NOBODY else branches on the
+registry's key strings. This script walks the ``commefficient_tpu``
+package ASTs and fails on any
 
   * comparison involving a dispatch name/attribute
     (``cfg.mode == "sketch"``, ``mode != 'fedavg'``,
@@ -23,6 +25,9 @@ outside that family's allowlist:
   * ``control_policy`` -> ``control/`` (the policy registry)
                           + ``utils/config.py`` (flag validation; other
                           layers gate on ``cfg.control_enabled``)
+  * ``recover_policy`` -> ``resilience/`` (the recovery-policy registry)
+                          + ``utils/config.py`` (flag validation; other
+                          layers gate on ``cfg.recovery_enabled``)
 
 AST-based so docstrings/comments that merely MENTION modes or policies
 never false-positive.
@@ -48,6 +53,7 @@ PACKAGE = REPO / "commefficient_tpu"
 FAMILIES = {
     "mode": ("compress/", "utils/config.py"),
     "control_policy": ("control/", "utils/config.py"),
+    "recover_policy": ("resilience/", "utils/config.py"),
 }
 
 
@@ -129,12 +135,14 @@ def main() -> int:
     if violations:
         n = sum(len(h) for h in violations.values())
         print(f"\n{n} violation(s). Mode dispatch belongs in "
-              "commefficient_tpu/compress/ (the registry), policy "
-              "dispatch in commefficient_tpu/control/, or utils/config.py "
-              "(flag validation/conveniences); route other layers through "
-              "compress.get_compressor / control.build_controller / "
+              "commefficient_tpu/compress/ (the registry), control-policy "
+              "dispatch in commefficient_tpu/control/, recovery-policy "
+              "dispatch in commefficient_tpu/resilience/, or "
+              "utils/config.py (flag validation/conveniences); route "
+              "other layers through compress.get_compressor / "
+              "control.build_controller / resilience.build_resilience / "
               "Config properties (cfg.control_enabled, "
-              "cfg.round_microbatches).")
+              "cfg.recovery_enabled, cfg.round_microbatches).")
         return 1
     return 0
 

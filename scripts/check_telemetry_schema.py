@@ -54,13 +54,17 @@ from pathlib import Path
 # "controller" block; v5 (pipelined round execution PR): pipeline/*
 # scalar namespace (occupancy in [0, 1] and integer staged_rounds
 # enforced below), spans thread_name "M" metadata events + per-lane
-# tids. Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5)
+# tids; v6 (self-healing training PR): resilience/* scalar namespace
+# (integer counters, preempt_requested in {0, 1}, rollback_round >= -1 —
+# enforced below), the flight dump's recovery_history block (one entry
+# per divergence rollback), and the fedsim/preempt scheduled-preemption
+# stat. Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
-                   "control/", "pipeline/")
+                   "control/", "pipeline/", "resilience/")
 
 
 class SchemaError(ValueError):
@@ -199,6 +203,67 @@ def _check_pipeline_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_resilience_scalar(name: str, v, where: str) -> None:
+    """v6 ``resilience/*`` value invariants. Host-computed gauges like the
+    pipeline/* family (never legitimately non-finite, so the nan/inf
+    markers are rejected too): ``recoveries`` / ``rung_demotions`` /
+    ``blacklisted_clients`` COUNT whole events/clients and must be
+    non-negative integers; ``preempt_requested`` is a 0/1 flag;
+    ``rollback_round`` is the last rollback target round, -1 when the run
+    never rolled back."""
+    if not name.startswith("resilience/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name in ("resilience/recoveries", "resilience/rung_demotions",
+                "resilience/blacklisted_clients") and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: {name} {v} is not a non-negative integer — it "
+            "counts whole recovery events/clients"
+        )
+    if name == "resilience/preempt_requested" and v not in (0, 1, 0.0, 1.0):
+        raise SchemaError(
+            f"{where}: resilience/preempt_requested {v} is not a 0/1 flag"
+        )
+    if name == "resilience/rollback_round" and (v != int(v) or v < -1):
+        raise SchemaError(
+            f"{where}: resilience/rollback_round {v} must be an integer "
+            ">= -1 (-1 = never rolled back)"
+        )
+
+
+def _check_recovery_history(hist, where: str) -> None:
+    """v6 flight ``recovery_history`` block: one entry per divergence
+    rollback, in recovery order."""
+    if not isinstance(hist, list) or not hist:
+        raise SchemaError(f"{where}: recovery_history must be a non-empty "
+                          "list of recovery entries")
+    for j, entry in enumerate(hist):
+        w = f"{where}:recovery_history[{j}]"
+        if not isinstance(entry, dict):
+            raise SchemaError(f"{w}: expected an object")
+        n = _req(entry, "recovery", int, w)
+        if n != j + 1:
+            raise SchemaError(
+                f"{w}: recovery ordinal {n} out of order (expected {j + 1})"
+            )
+        _req(entry, "policy", str, w)
+        fb = _req(entry, "first_bad_step", int, w)
+        if fb < 0:
+            raise SchemaError(f"{w}: negative first_bad_step")
+        _req(entry, "outcome", str, w)
+        if "rollback_to" in entry and entry["rollback_to"] is not None:
+            rb = _req(entry, "rollback_to", int, w)
+            if not 0 <= rb <= fb:
+                raise SchemaError(
+                    f"{w}: rollback_to {rb} outside [0, first_bad_step="
+                    f"{fb}] — a rollback target must be pre-divergence"
+                )
+
+
 def validate_metrics_jsonl(path) -> int:
     """Validate a metrics.jsonl; returns the number of scalar records."""
     n_scalars = 0
@@ -234,6 +299,7 @@ def validate_metrics_jsonl(path) -> int:
                 raise SchemaError(f"{where}: missing required field 'value'")
             _check_scalar_value(rec["value"], name, where)
             _check_pipeline_scalar(name, rec["value"], where)
+            _check_resilience_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
@@ -385,6 +451,11 @@ def validate_flight(path) -> dict:
         _check_controller_block(
             _req(rec, "controller", dict, where), where + ":controller"
         )
+    if "recovery_history" in rec:
+        # v6 self-healing runs: every rollback this run survived (policy,
+        # first bad round, rollback target, outcome) — surfaced top-level
+        # by FlightRecorder.dump via the attached resilience rider
+        _check_recovery_history(rec["recovery_history"], where)
     if "participation_history" in rec:
         # fedsim runs: the [step, participation_rate] window surfaced
         # top-level by FlightRecorder.dump
@@ -412,6 +483,7 @@ def validate_flight(path) -> dict:
             _check_scalar_name(name, w, allow_bare_aux=True)
             _check_scalar_value(v, name, w)
             _check_pipeline_scalar(name, v, w)
+            _check_resilience_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step

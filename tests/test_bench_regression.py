@@ -148,6 +148,28 @@ def test_new_metrics_counted_and_guarded(tmp_path, capsys):
     assert new == ["gpt2_sketch_v2_tokens_per_sec"]
 
 
+def test_retraces_gauge_gated_exact_zero(tmp_path):
+    """Resilience PR: every *_retraces leg gauge is a hard invariant —
+    ANY non-zero value fails, with or without history (a relative band
+    on an all-zero trajectory would never fire)."""
+    mod = _gate()
+    assert mod.metric_direction("sketch_resilience_retraces") is None
+    # zero passes, even as the metric's first appearance
+    _write(tmp_path, "BENCH_r01.json", BASELINE)
+    _write(tmp_path, "BENCH_r02.json",
+           {**BASELINE, "sketch_resilience_retraces": 0,
+            "sketch_ladder_retraces": 0})
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    # a retrace fails outright and names the gauge
+    regs, _, _ = mod.check_regression(
+        [BASELINE], {**BASELINE, "sketch_resilience_retraces": 1})
+    assert [r["metric"] for r in regs] == ["sketch_resilience_retraces"]
+    assert regs[0]["direction"] == "exact_zero"
+    _write(tmp_path, "BENCH_r03.json",
+           {**BASELINE, "sketch_ladder_retraces": 2})
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+
+
 def test_pipeline_leg_metrics_registered():
     """The sketch_pipelined bench leg's gate-worthy keys have directions
     (throughput + occupancy gate; the near-zero stall stays

@@ -119,7 +119,10 @@ def test_chaos_parser_grammar():
     "dropout@0.3:r=5",       # unknown option
     "nan_client@-1",         # negative round
     "nan_client@1.5",        # fractional round
-    "nan_client@3:rounds=1-2",  # nan_client takes no rounds=
+    # the counted nan_client@N:rounds=A-B form (resilience PR) takes a
+    # client COUNT >= 1 before the window — 0/fractional still rejected
+    "nan_client@0:rounds=1-2",
+    "nan_client@1.5:rounds=1-2",
     "dropout",               # no @value
 ])
 def test_chaos_parser_rejects(bad):

@@ -1,13 +1,13 @@
-"""The compress/ and control/ subsystem boundaries, enforced in tier-1.
+"""The compress/, control/ and resilience/ subsystem boundaries,
+enforced in tier-1.
 
-Three invariants: (1) no mode-string dispatch outside compress/ +
-utils/config.py and no control_policy-string dispatch outside control/ +
-utils/config.py (scripts/check_mode_dispatch.py, so the registry
-boundaries can't silently erode), (2) the compress registry and the CLI's
-MODES tuple stay in sync, and (3) the control policy registry and the
-CLI's CONTROL_POLICIES tuple stay in sync (a registered-but-unlisted
-entry would be unreachable from the CLI; a listed-but-unregistered one
-would crash at build)."""
+Two invariant families: (1) no registry-key string dispatch outside its
+home package + utils/config.py — mode -> compress/, control_policy ->
+control/, recover_policy -> resilience/ (scripts/check_mode_dispatch.py,
+so the registry boundaries can't silently erode); (2) each registry and
+its CLI tuple stay in sync — MODES, CONTROL_POLICIES, RECOVER_POLICIES
+(a registered-but-unlisted entry would be unreachable from the CLI; a
+listed-but-unregistered one would crash at build)."""
 
 import importlib.util
 import os
@@ -103,6 +103,37 @@ def test_lint_detects_control_policy_dispatch(tmp_path):
     assert lint.scan_file(clean) == []
 
 
+def test_lint_detects_recover_policy_dispatch(tmp_path):
+    """The recover_policy family (resilience/ PR): branching on the
+    recovery-policy string outside resilience/ must be flagged; gating on
+    cfg.recovery_enabled must NOT be."""
+    lint = _lint()
+    bad = tmp_path / "bad_resil.py"
+    bad.write_text(
+        "def f(cfg):\n"
+        "    if cfg.recover_policy == 'retry':\n"
+        "        pass\n"
+        "    h = {'demote': 1}[cfg.recover_policy]\n"
+        "    match cfg.recover_policy:\n"
+        "        case 'skip_clients':\n"
+        "            pass\n"
+    )
+    hits = lint.scan_file(bad)
+    assert [(ln, fam) for ln, fam, _ in hits] == [
+        (2, "recover_policy"), (4, "recover_policy"),
+        (5, "recover_policy"),
+    ]
+
+    clean = tmp_path / "clean_resil.py"
+    clean.write_text(
+        "def g(cfg, session):\n"
+        "    if cfg.recovery_enabled:\n"
+        "        pass\n"
+        "    return cfg.recover_policy  # reading it is fine\n"
+    )
+    assert lint.scan_file(clean) == []
+
+
 def test_lint_family_restriction(tmp_path):
     """scan_file(families=...) is what scan_package uses to apply
     per-family allowlists — a file allowed for one family must still be
@@ -139,6 +170,10 @@ def test_lint_allowlists_compress_config_and_control():
         "utils/config.py is expected to branch on control_policy "
         "(validation)"
     )
+    assert any(fam == "recover_policy" for _, fam, _ in cfg_hits), (
+        "utils/config.py is expected to branch on recover_policy "
+        "(validation)"
+    )
     pol_hits = lint.scan_file(Path(pkg, "control", "policy.py"))
     assert any(fam == "control_policy" for _, fam, _ in pol_hits), (
         "control/policy.py is expected to branch on control_policy "
@@ -158,6 +193,13 @@ def test_policy_registry_matches_config_policies():
     from commefficient_tpu.utils.config import CONTROL_POLICIES
 
     assert set(POLICIES) | {"none"} == set(CONTROL_POLICIES)
+
+
+def test_recovery_registry_matches_config_policies():
+    from commefficient_tpu.resilience.policy import POLICIES
+    from commefficient_tpu.utils.config import RECOVER_POLICIES
+
+    assert set(POLICIES) | {"none"} == set(RECOVER_POLICIES)
 
 
 def test_unknown_mode_rejected_with_registered_list():

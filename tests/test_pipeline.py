@@ -402,7 +402,7 @@ def test_fedsim_realization_fault_surfaces():
         pipeline_depth=2,
     )
 
-    def boom(round_idx):
+    def boom(round_idx, replay=False):
         raise RuntimeError(f"fedsim validation failed at {round_idx}")
 
     sess.fedsim_env.round_env = boom

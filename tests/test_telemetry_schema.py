@@ -373,3 +373,103 @@ def test_v5_spans_lane_labels_survive_ring_eviction(tmp_path):
     rec = mod.validate_spans(spans.close())
     meta = [e for e in rec["traceEvents"] if e["ph"] == "M"]
     assert [(e["tid"], e["args"]["name"]) for e in meta] == [(0, "main")]
+
+
+# ---------------------------------------------------------------------------
+# v6: resilience/* scalars + the flight recovery_history block
+# ---------------------------------------------------------------------------
+
+def test_v6_resilience_scalars_validate_and_reject(tmp_path):
+    """The resilience/ scalar prefix is in-schema through the REAL
+    writer; the counter/flag/rollback-round invariants are enforced
+    (tampered values rejected)."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1,
+                 recover_policy="retry")
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("resilience/recoveries", float(s > 1), s)
+        writer.scalar("resilience/rollback_round", -1.0 if s < 2 else 1.0, s)
+        writer.scalar("resilience/rung_demotions", 0.0, s)
+        writer.scalar("resilience/blacklisted_clients", 0.0, s)
+        writer.scalar("resilience/preempt_requested", 0.0, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 21
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "resilience/recoveries", "value": -1.0, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "resilience/recoveries", "value": 0.5, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "resilience/blacklisted_clients", "value": 1.5,
+          "step": 0, "t": 1.0}, "non-negative integer"),
+        ({"name": "resilience/preempt_requested", "value": 0.5, "step": 0,
+          "t": 1.0}, "0/1 flag"),
+        ({"name": "resilience/rollback_round", "value": -2.0, "step": 0,
+          "t": 1.0}, ">= -1"),
+        ({"name": "resilience/rollback_round", "value": 1.5, "step": 0,
+          "t": 1.0}, ">= -1"),
+        ({"name": "resilience/recoveries", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+class _FakeResilienceRider:
+    """Duck-typed the way FlightRecorder consumes it: a ``history``
+    attribute holding the recovery entries."""
+
+    def __init__(self, history):
+        self.history = history
+
+
+def test_v6_flight_recovery_history_validates_and_rejects(tmp_path):
+    """A recovery-carrying flight dump (the _recovery-tagged sibling the
+    manager writes) validates through the REAL recorder, and the checker
+    rejects out-of-order ordinals, post-divergence rollback targets, and
+    empty blocks."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1,
+                 recover_policy="retry")
+    flight = FlightRecorder(cfg, logdir=str(tmp_path))
+    flight.resilience = _FakeResilienceRider([
+        {"recovery": 1, "policy": "retry", "first_bad_step": 5,
+         "reason": "diag/nonfinite", "outcome": "recovered",
+         "rollback_to": 4},
+        {"recovery": 2, "policy": "retry", "first_bad_step": 8,
+         "reason": "diag/nonfinite", "outcome": "recovered",
+         "rollback_to": 8},
+    ])
+    for s in range(3):
+        flight.record(s, 0.1, {"loss": 1.0})
+    path = flight.dump(5, reason="recovered from divergence at round 5",
+                       first_bad_step=5, tag="_recovery")
+    assert path.endswith("flight_5_recovery.json")
+    rec = mod.validate_flight(path)
+    assert len(rec["recovery_history"]) == 2
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_flight.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_flight(bad)
+
+    tampered(lambda r: r["recovery_history"][1].update(recovery=3),
+             "out of order")
+    tampered(lambda r: r["recovery_history"][0].update(rollback_to=6),
+             "pre-divergence")
+    tampered(lambda r: r["recovery_history"][0].pop("policy"), "policy")
+    tampered(lambda r: r.update(recovery_history=[]), "non-empty")
+    tampered(lambda r: r["recovery_history"][0].update(first_bad_step=-1),
+             "negative first_bad_step")
