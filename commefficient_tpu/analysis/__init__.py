@@ -1,0 +1,50 @@
+"""Invariant linter: static analysis enforcing the contracts the test
+suite can only check after a violation ships.
+
+Five analyzers over a shared AST/call-graph core (``core.py``):
+
+  * ``traced-purity``      — no wall-clock/host-rng/print/IO or tracer
+                             coercion in code reachable from
+                             jit/shard_map/pallas_call roots
+                             (``purity.py``);
+  * ``rng-stream``         — seeds derive from declared stream
+                             constants; no bare ``default_rng()``,
+                             inline tags, global streams, or jax key
+                             reuse without split/fold_in (``rng.py``);
+  * ``collective-axis``    — collective axis names are the declared
+                             mesh constants, never inline string
+                             literals (``collectives.py``);
+  * ``registry-dispatch``  — no mode/policy key-string dispatch outside
+                             its home package (``dispatch.py``; the
+                             ``scripts/check_mode_dispatch.py`` lint,
+                             ported — the script remains as a shim);
+  * ``exception-hygiene``  — no bare ``except:`` / silently swallowed
+                             ``except Exception: pass`` in library code
+                             (``exceptions.py``).
+
+Suppressions are per line and per rule with a MANDATORY reason —
+``# lint: allow[rule-name] <reason>`` on the violating line, the line
+above it, or atop the multi-line statement containing it — and a
+malformed pragma is itself a violation. Run it:
+
+    python -m commefficient_tpu.analysis              # exit 1 on findings
+    python -m commefficient_tpu.analysis --list-rules
+    python -m commefficient_tpu.analysis --rules traced-purity,rng-stream
+    python -m commefficient_tpu.analysis --json
+
+The last stdout line is always a machine-readable JSON summary
+(``{"kind": "invariant_lint", ...}``) on every exit path — the same
+consumer contract as the other gate scripts. Wired into tier-1 by
+tests/test_analysis.py (clean-package gate + per-rule detects-violation
+self-tests). Pure stdlib ``ast`` — importing this package never imports
+jax.
+"""
+
+from commefficient_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    PackageIndex,
+    analyzer_registry,
+    run_analyzers,
+)
+
+__all__ = ["Finding", "PackageIndex", "analyzer_registry", "run_analyzers"]

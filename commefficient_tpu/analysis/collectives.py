@@ -1,0 +1,105 @@
+"""collective-axis: mesh axes are named by constants, never inline
+string literals.
+
+Every collective in the package runs over an axis of the (workers,
+model, seq) mesh that ``parallel/mesh.py`` declares as constants
+(``WORKERS``/``MODEL``/``SEQ``). The moment a call site writes
+``jax.lax.psum(x, "workers")`` instead, two things rot: a mesh-axis
+rename (ROADMAP item 1's ``hosts x chips`` 2D mesh will add axes and
+re-plumb existing ones) becomes a repo-wide grep for magic strings, and
+a typo'd axis (``"worker"``) surfaces only as a runtime NameError deep
+inside a traced program instead of an undefined-name at import. The
+constants are the single point of truth; this analyzer makes them the
+only legal spelling at collective call sites.
+
+Flagged:
+
+  * a string literal (or a tuple/list containing one) passed as the
+    axis argument of a known collective — ``psum``/``pmean``/``pmax``/
+    ``pmin``/``psum_scatter``/``all_gather``/``all_to_all``/
+    ``ppermute``/``pshuffle``/``axis_index``/``pbroadcast``/``pcast``
+    (final-name match, so ``jax.lax.psum`` and the ``jax_compat``
+    shims both count); the axis argument is the first positional for
+    ``axis_index``, the second otherwise, or the ``axis_name=`` kwarg;
+  * a string literal passed as an ``axis_name=`` keyword to ANY call —
+    the kwarg name is distinctive enough that ``partial(ring_attention,
+    axis_name="seq")`` and ``server_update_sharded(..., axis_name=...)``
+    are covered without enumerating every wrapper.
+
+Declaring the constant itself (``WORKERS = "workers"`` in
+``parallel/mesh.py``) is an assignment, not a call, and stays legal —
+as do ``PartitionSpec`` strings, which name shardings, not collective
+axes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from commefficient_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    final_name,
+)
+
+RULE = "collective-axis"
+DESCRIPTION = (
+    "collective axis names must be declared mesh-axis constants "
+    "(WORKERS/MODEL/SEQ), never inline string literals"
+)
+
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "axis_index", "pbroadcast",
+    "pcast",
+})
+
+
+def _literal_axes(expr: ast.AST):
+    """The string-literal leaves of an axis expression (handles single
+    strings and tuple/list axis groups like ``(WORKERS, "seq")``)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        yield expr
+    elif isinstance(expr, (ast.Tuple, ast.List)):
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                yield el
+
+
+def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    pos = 0 if final_name(call.func) == "axis_index" else 1
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def analyze(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in index.trees():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = final_name(node.func)
+            checked = None
+            if name in COLLECTIVES:
+                checked = _axis_arg(node)
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        checked = kw.value
+                        break
+            if checked is None:
+                continue
+            for lit in _literal_axes(checked):
+                findings.append(sf.finding(
+                    RULE, lit.lineno,
+                    f"inline axis-name literal {lit.value!r} at a "
+                    f"collective call ({name or 'axis_name kwarg'}) — "
+                    "use the declared mesh-axis constant "
+                    "(parallel.mesh.WORKERS/MODEL/SEQ)",
+                ))
+    return findings

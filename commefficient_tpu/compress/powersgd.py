@@ -52,6 +52,16 @@ import jax.numpy as jnp
 from commefficient_tpu.compress.base import KIND_DENSE, KIND_NONE, Compressor
 from commefficient_tpu.compress.registry import register
 
+# rng stream tag for the Q-matrix draws: fold_in(key(cfg.seed),
+# POWERSGD_Q_STREAM) is disjoint from the round engine's
+# fold_in(key(cfg.seed), step) stream for any run under 0x9051 = 36945
+# rounds (at exactly step 36945 the two keys coincide — far beyond every
+# configured run here, but a bound, not a never), and from every other
+# subsystem's declared tag (rng-stream lint makes tags greppable). Value
+# predates the naming — changing it would change every warm-start draw
+# bit-for-bit.
+POWERSGD_Q_STREAM = 0x9051
+
 
 def matrix_shape(d: int) -> Tuple[int, int]:
     """Near-square matricization [n, m] of a flat [d] vector, n*m >= d.
@@ -134,12 +144,14 @@ class PowerSGDCompressor(Compressor):
         # FedState/checkpoints carry () instead of a dead [m, r] array.
         if not self.cfg.powersgd_warm_start:
             return ()
-        key = jax.random.fold_in(jax.random.key(self.cfg.seed), 0x9051)
+        key = jax.random.fold_in(jax.random.key(self.cfg.seed),
+                                 POWERSGD_Q_STREAM)
         return jax.random.normal(key, (self.m, self.rank), jnp.float32)
 
     def _fresh_q(self, step):
         key = jax.random.fold_in(
-            jax.random.fold_in(jax.random.key(self.cfg.seed), 0x9051), step
+            jax.random.fold_in(jax.random.key(self.cfg.seed),
+                               POWERSGD_Q_STREAM), step
         )
         return jax.random.normal(key, (self.m, self.rank), jnp.float32)
 

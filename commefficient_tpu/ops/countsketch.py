@@ -495,6 +495,11 @@ class CountSketch(NamedTuple):
     def _poly4_coeffs(self, row: int, purpose: int) -> np.ndarray:
         """[4] uint64 in [1, p): seed-derived coefficients for this row's
         degree-3 hash polynomial (purpose 0 = bucket slots, 1 = signs)."""
+        # host rng at TRACE time, on purpose: SeedSequence((spec.seed,
+        # row, purpose)) is a pure function of the sketch spec, so every
+        # trace bakes the SAME coefficient table — replay/retrace-safe
+        # by construction (pinned by the golden parity recordings).
+        # lint: allow[traced-purity] seed-derived trace-time constants
         rng = np.random.default_rng(
             np.random.SeedSequence([int(self.seed) & 0x7FFFFFFF, row, purpose])
         )

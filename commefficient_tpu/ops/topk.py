@@ -116,6 +116,9 @@ def compact_nonzero(v: jnp.ndarray, k: int):
     selection, which cannot exceed k).
     """
     n = v.shape[0]
+    # k sizes the fixed output buffers, so it CANNOT be a tracer — a
+    # traced k would already fail shape inference on the arange below
+    # lint: allow[traced-purity] k is a static Python int by contract
     kb = min(int(k), n)
     csum = jnp.cumsum((v != 0).astype(jnp.int32))
     total = csum[-1]

@@ -45,7 +45,10 @@ def run_metadata(cfg=None, extra: Optional[dict] = None) -> dict:
         meta["device_kind"] = devs[0].device_kind
         meta["device_count"] = len(devs)
         meta["backend"] = jax.default_backend()
-    except Exception:  # noqa: BLE001 — metadata must never kill a run
+    # a missing/broken jax backend leaves the identity fields absent
+    # rather than killing the run this metadata merely describes
+    # lint: allow[exception-hygiene] metadata is best-effort
+    except Exception:
         pass
     if cfg is not None:
         if dataclasses.is_dataclass(cfg):

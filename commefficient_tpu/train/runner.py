@@ -411,7 +411,10 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
                 live_drain[0]()
             except DivergenceError:
                 raise
-            except Exception:  # noqa: BLE001 — the original error wins
+            # flushing inside the original failure's handler — a flush
+            # error must not mask it; record_crash below preserves it
+            # lint: allow[exception-hygiene] the original error wins
+            except Exception:
                 pass
         # divergence already dumped its own flight record in the drain;
         # any OTHER crash dumps the recent trajectory for the post-mortem

@@ -105,7 +105,16 @@ class MetricsWriter:
                 from torch.utils.tensorboard import SummaryWriter  # type: ignore
 
                 self._tb = SummaryWriter(logdir)
-            except Exception:
+            except Exception as e:
+                # degrade to JSONL-only, but SAY so (exception-hygiene):
+                # the caller asked for tensorboard, and a silent None here
+                # costs them the curves with no clue until hours later
+                import warnings
+
+                warnings.warn(
+                    f"MetricsWriter: tensorboard unavailable "
+                    f"({type(e).__name__}: {e}); logging JSONL-only"
+                )
                 self._tb = None
 
     def _write_header(self, cfg, extra_header=None) -> None:

@@ -1,140 +1,71 @@
 """Lint: registry-keyed dispatch must not leak out of its home package.
 
-The compress/ registry refactor (PR 2) moved every mode's algebra behind
-``compress.get_compressor``; the control/ subsystem (PR 8) did the same
-for rung-selection policies behind ``control.policy.get_policy``; the
-resilience/ subsystem (PR 10) for recovery policies behind
-``resilience.policy.get_recovery_policy``. The invariant that keeps a new
-compressor (or policy) a one-file PR is that NOBODY else branches on the
-registry's key strings. This script walks the ``commefficient_tpu``
-package ASTs and fails on any
-
-  * comparison involving a dispatch name/attribute
-    (``cfg.mode == "sketch"``, ``mode != 'fedavg'``,
-    ``cfg.control_policy in (...)``),
-  * dict/registry subscript keyed by a dispatch expression
-    (``{...}[cfg.mode]``, ``POLICIES[cfg.control_policy]``),
-  * ``match cfg.mode:`` / ``match cfg.control_policy:`` statement,
-
-outside that family's allowlist:
-
-  * ``mode``           -> ``compress/`` (the registry owns mode dispatch)
-                          + ``utils/config.py`` (CLI validation and
-                          mode-derived conveniences like
-                          ``round_microbatches`` live with the flags)
-  * ``control_policy`` -> ``control/`` (the policy registry)
-                          + ``utils/config.py`` (flag validation; other
-                          layers gate on ``cfg.control_enabled``)
-  * ``recover_policy`` -> ``resilience/`` (the recovery-policy registry)
-                          + ``utils/config.py`` (flag validation; other
-                          layers gate on ``cfg.recovery_enabled``)
-
-AST-based so docstrings/comments that merely MENTION modes or policies
-never false-positive.
-
-Scope is the library package only: tests, bench.py, and scripts are
-harnesses that parametrize over modes by construction. Wired into tier-1
-via tests/test_mode_dispatch.py.
+Since the invariant-linter PR this script is a THIN SHIM over the
+framework analyzer ``commefficient_tpu/analysis/dispatch.py`` (the
+``registry-dispatch`` rule of ``python -m commefficient_tpu.analysis``),
+which carries the full rationale and the family allowlists. The CLI and
+exit semantics here are unchanged from the original script:
 
     python scripts/check_mode_dispatch.py        # exit 1 on violations
+
+  * exit 0 — no violations; 1 — violations (one prose line each, plus
+    the routing epilogue); 2 — usage error (the script takes no args).
+  * ``scan_file(path, families=None)`` and ``scan_package()`` keep
+    their original signatures and return shapes (re-exported from the
+    analyzer), so tests/test_mode_dispatch.py and any caller importing
+    this file keep working unchanged.
+  * NEW: the last stdout line is a machine-readable JSON summary
+    ``{"kind": "mode_dispatch", "violations": N, "files": M, ...}`` on
+    EVERY exit path — the same consumer contract as
+    scripts/check_bench_regression.py and the analysis CLI.
+
+Violations honor the framework pragma grammar
+(``# lint: allow[registry-dispatch] <reason>``), like every other rule.
 """
 
 from __future__ import annotations
 
-import ast
+import json
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "commefficient_tpu"
+sys.path.insert(0, str(REPO))
 
-# dispatch family -> (paths, relative to the package root, where that
-# family's dispatch is LEGAL)
-FAMILIES = {
-    "mode": ("compress/", "utils/config.py"),
-    "control_policy": ("control/", "utils/config.py"),
-    "recover_policy": ("resilience/", "utils/config.py"),
-}
+from commefficient_tpu.analysis import dispatch as _dispatch  # noqa: E402
+from commefficient_tpu.analysis.core import PackageIndex  # noqa: E402
 
-
-def _dispatch_name(node: ast.AST):
-    """The family name for expressions naming a dispatch key (``mode``,
-    ``*.mode``, ``control_policy``, ``*.control_policy``), else None."""
-    if isinstance(node, ast.Name) and node.id in FAMILIES:
-        return node.id
-    if isinstance(node, ast.Attribute) and node.attr in FAMILIES:
-        return node.attr
-    return None
+# re-exports: the original module-level API, now framework-backed
+FAMILIES = _dispatch.FAMILIES
+PACKAGE = _dispatch.PACKAGE
+scan_file = _dispatch.scan_file
+scan_package = _dispatch.scan_package
 
 
-def scan_file(path: Path, families=None) -> list:
-    """[(lineno, family, snippet)] of dispatch violations in one file.
-    ``families``: restrict to these family names (default: all)."""
-    src = path.read_text()
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as e:  # a broken file is its own CI problem
-        return [(e.lineno or 0, "?", f"unparseable: {e.msg}")]
-    lines = src.splitlines()
-    out = []
-
-    def hit(node, family):
-        if families is not None and family not in families:
-            return
-        ln = getattr(node, "lineno", 0)
-        snippet = lines[ln - 1].strip() if 0 < ln <= len(lines) else ""
-        out.append((ln, family, snippet))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Compare):
-            for expr in [node.left, *node.comparators]:
-                fam = _dispatch_name(expr)
-                if fam is not None:
-                    hit(node, fam)
-                    break
-        elif isinstance(node, ast.Subscript):
-            fam = _dispatch_name(node.slice)
-            if fam is not None:
-                hit(node, fam)
-        elif isinstance(node, ast.Match):
-            fam = _dispatch_name(node.subject)
-            if fam is not None:
-                hit(node, fam)
-    return sorted(out)  # ast.walk is BFS; report in source order
+def _summary_line(**kw) -> None:
+    print(json.dumps({"kind": "mode_dispatch", **kw}))
 
 
-def scan_package(package_root: Path = PACKAGE) -> dict:
-    """{relative_path: [(lineno, family, snippet)]} over the package,
-    per-family allowlists applied."""
-    violations = {}
-    for path in sorted(package_root.rglob("*.py")):
-        rel = path.relative_to(package_root).as_posix()
-        # only lint each family where its own allowlist does NOT cover
-        # this file — a file may be home to one family and off-limits to
-        # the other (utils/config.py is allowlisted for both; control/
-        # may validate policies but not branch on cfg.mode)
-        banned = tuple(
-            fam for fam, allowed in FAMILIES.items()
-            if not any(rel == a or rel.startswith(a) for a in allowed)
-        )
-        if not banned:
-            continue
-        hits = scan_file(path, families=banned)
-        if hits:
-            violations[rel] = hits
-    return violations
-
-
-def main() -> int:
-    violations = scan_package()
-    for rel, hits in violations.items():
-        for ln, fam, snippet in hits:
-            home = FAMILIES.get(fam, ("?",))[0]
-            print(f"commefficient_tpu/{rel}:{ln}: {fam}-string dispatch "
-                  f"outside {home}: {snippet}")
-    if violations:
-        n = sum(len(h) for h in violations.values())
-        print(f"\n{n} violation(s). Mode dispatch belongs in "
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        msg = f"usage: {Path(__file__).name} takes no arguments"
+        print(msg)
+        _summary_line(violations=0, files=0, findings=[], error=msg)
+        return 2
+    index = PackageIndex(PACKAGE)
+    # an unparseable package file fails the gate (original-script
+    # semantics: "a broken file is its own CI problem" — it could hide
+    # any amount of dispatch), alongside the dispatch findings proper
+    findings = index.parse_findings()
+    findings += [f for f in _dispatch.analyze(index)
+                 if not index.suppressed(f)]
+    findings.sort()
+    for f in findings:
+        print(f"commefficient_tpu/{f.path}:{f.lineno}: "
+              f"{f.message.split(' — ')[0]}: {f.snippet}")
+    if findings:
+        print(f"\n{len(findings)} violation(s). Mode dispatch belongs in "
               "commefficient_tpu/compress/ (the registry), control-policy "
               "dispatch in commefficient_tpu/control/, recovery-policy "
               "dispatch in commefficient_tpu/resilience/, or "
@@ -143,8 +74,12 @@ def main() -> int:
               "control.build_controller / resilience.build_resilience / "
               "Config properties (cfg.control_enabled, "
               "cfg.recovery_enabled, cfg.round_microbatches).")
-        return 1
-    return 0
+    _summary_line(
+        violations=len(findings),
+        files=len({f.path for f in findings}),
+        findings=[f.to_dict() for f in findings],
+    )
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

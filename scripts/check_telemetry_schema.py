@@ -703,17 +703,38 @@ def validate_run_dir(run_dir) -> dict:
 
 
 def main(argv) -> int:
+    # the last stdout line is ALWAYS a machine-readable JSON summary —
+    # {"kind": "telemetry_schema", "run_dirs": N, "artifacts": M,
+    #  "failures": [...]} — on every exit path including usage errors,
+    # the consumer contract scripts/check_bench_regression.py
+    # established for gate scripts (pinned by tests/test_telemetry_schema)
+    def summary_line(**kw):
+        print(json.dumps({"kind": "telemetry_schema", **kw}))
+
     if not argv:
         print(__doc__)
+        summary_line(run_dirs=0, artifacts=0, failures=[],
+                     error="usage: pass one or more run dirs")
         return 2
     rc = 0
+    n_artifacts = 0
+    failures = []
     for run_dir in argv:
         try:
             for path, summary in validate_run_dir(run_dir).items():
                 print(f"OK   {path}: {summary}")
-        except SchemaError as e:
+                n_artifacts += 1
+        # ValueError covers SchemaError and a truncated/corrupt
+        # artifact's raw JSONDecodeError (both subclass it); OSError an
+        # unreadable path — each must fail THIS run dir and still end
+        # stdout with the summary line, not escape as a traceback (the
+        # corrupted-artifact case is what a gate script exists to catch)
+        except (OSError, ValueError) as e:
             print(f"FAIL {e}")
+            failures.append(str(e))
             rc = 1
+    summary_line(run_dirs=len(argv), artifacts=n_artifacts,
+                 failures=failures)
     return rc
 
 

@@ -1,0 +1,25 @@
+"""Invariant linter entry point (path-based shim).
+
+Exactly ``python -m commefficient_tpu.analysis`` — same flags
+(``--rules``, ``--json``, ``--list-rules``, ``--root``), same exit codes
+(0 clean / 1 findings / 2 usage), same last-stdout-line JSON summary —
+for environments that invoke gate scripts by path:
+
+    python scripts/lint.py
+    python scripts/lint.py --rules traced-purity,rng-stream --json
+
+See commefficient_tpu/analysis/__init__.py for the rule catalogue and
+README "Static analysis & invariants" for the pragma grammar.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from commefficient_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,6 +269,42 @@ def test_cli_exit_codes(tmp_path):
     assert mod.main([str(tmp_path / "empty")]) == 1
 
 
+def test_cli_json_summary_always_last_line(tmp_path, capsys):
+    """The gate-script consumer contract (established by
+    scripts/check_bench_regression.py, now uniform across all gate
+    scripts): the last stdout line is machine-readable JSON on EVERY
+    exit path — pass, fail, and usage error."""
+    mod = _checker()
+    run_dir = _write_run(tmp_path)
+
+    def last(capsys):
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    assert mod.main([run_dir]) == 0
+    s = last(capsys)
+    assert s["kind"] == "telemetry_schema"
+    assert s["run_dirs"] == 1 and s["artifacts"] >= 3
+    assert s["failures"] == []
+
+    (tmp_path / "empty2").mkdir()
+    assert mod.main([str(tmp_path / "empty2")]) == 1
+    s = last(capsys)
+    assert s["failures"] and "no telemetry artifacts" in s["failures"][0]
+
+    assert mod.main([]) == 2  # usage error still ends with the summary
+    s = last(capsys)
+    assert s["kind"] == "telemetry_schema" and "error" in s
+
+    # a TRUNCATED artifact (raw JSONDecodeError, not SchemaError) must
+    # fail the run dir and still end with the summary, not a traceback
+    bad = tmp_path / "corrupt"
+    bad.mkdir()
+    (bad / "comm_ledger.json").write_text("{truncated")
+    assert mod.main([str(bad)]) == 1
+    s = last(capsys)
+    assert s["failures"], "corrupt artifact must be reported in failures"
+
+
 # ---------------------------------------------------------------------------
 # v5: pipeline/* scalars + thread-aware spans
 # ---------------------------------------------------------------------------
