@@ -52,6 +52,13 @@ class Compressor:
     # True -> the fused flattened-batch gradient fast path is mathematically
     # identical for this mode (nothing per-client in the transmit rule)
     supports_fused_clients: bool = False
+    # True -> the class implements encode_grad_table() and the round may
+    # run the sketch-fused backward (cfg.sketch_fused_bwd): the worker's
+    # gradient is produced directly as an encoded table by per-leaf
+    # custom_vjp taps (ops.countsketch.sketch_grad_tap), so the flat [D]
+    # grad concat is never traced. Only meaningful on the fused
+    # flattened-batch path (one gradient per device).
+    supports_fused_backward: bool = False
     # True -> the applied delta is dense, so do_topk_down's downlink top-k
     # is meaningful (sketch/true_topk deltas already have <= k nonzeros;
     # powersgd's delta is rank-r factored)
@@ -132,7 +139,9 @@ class Compressor:
             if kind == KIND_DENSE:
                 return jnp.zeros((self.d,), f32)
             if kind == KIND_TABLE:
-                return jnp.zeros(table, f32)
+                # tables carry the spec's STORAGE dtype (bf16 halves the
+                # server-state HBM at GPT-2 scale; f32 default unchanged)
+                return jnp.zeros(table, self.spec.table_dtype)
             return ()
 
         return alloc(m_kind), alloc(e_kind), self.init_extra_state()
@@ -325,6 +334,14 @@ class Compressor:
     def upload_floats(self) -> int:
         """Per-client uplink floats per round."""
         return self.d
+
+    def upload_bytes_per_float(self) -> int:
+        """Bytes per uplink float (4 for every f32-payload mode; sketch
+        overrides to 2 when the tables — the psum payload — are stored
+        bf16). The session's ``bytes_per_round`` and the CommLedger's
+        live-byte accounting both multiply through this hook so the
+        ledger-vs-HLO cross check (telemetry/xla_audit.py) stays exact."""
+        return 4
 
     def download_floats(self) -> int:
         """Downlink floats per round (before any do_topk_down top-k)."""

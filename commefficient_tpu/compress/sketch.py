@@ -30,7 +30,32 @@ class SketchCompressor(Compressor):
     needs_sketch_spec = True
     supports_fused_clients = True
     supports_sharded_decode = True  # server_update_sharded below
+    supports_fused_backward = True  # encode_grad_table below
     dense_delta = False  # the unsketched delta already has <= k nonzeros
+
+    # ---- bf16 table discipline ------------------------------------------
+    # Tables may be STORED (and psummed) in spec.table_dtype (bf16 halves
+    # HBM + collective bytes at GPT-2 scale); every piece of server
+    # ALGEBRA upcasts to f32 first and downcasts only what is stored back
+    # — "bf16 tables, f32 accumulation". Both casts are no-ops for the
+    # f32 default (convert_element_type to the same dtype folds away), so
+    # the golden parity recordings are bit-untouched.
+    def _up(self, table):
+        return table if isinstance(table, tuple) else table.astype(jnp.float32)
+
+    def _down(self, table):
+        if isinstance(table, tuple):
+            return table
+        return table.astype(self.spec.table_dtype)
+
+    @property
+    def _spec_acc(self):
+        """The spec with f32 storage: interior re-sketches (zero-HH error
+        feedback, dampening) accumulate at f32, so only STORED state and
+        psum payloads pay the bf16 rounding. Identical to ``spec`` for
+        the f32 default (NamedTuple value equality keeps every lru-cached
+        geometry hit)."""
+        return self.spec._replace(table_dtype=jnp.float32)
 
     def _dampening_warnings(self, dampen: bool) -> None:
         if dampen:
@@ -63,17 +88,32 @@ class SketchCompressor(Compressor):
 
     def device_encode(self, local_sum):
         # one sketch per device; the psum over tables is exact by linearity
+        # (to bf16 rounding when table_dtype is bfloat16 — sketch_vec
+        # accumulates f32 and downcasts the final table, so the psum
+        # payload is half the bytes; see the class bf16 discipline note)
         return sketch_vec(self.spec, local_sum)
+
+    def encode_grad_table(self, table):
+        """``device_encode`` twin for the sketch-fused backward: the
+        worker's summed transmit arrives ALREADY as a sketch table (the
+        per-leaf custom_vjp taps accumulated their segment sketches in
+        f32 — ops.countsketch.sketch_grad_tap); only the psum payload
+        cast remains."""
+        return self._down(table)
 
     def server_update(self, momentum, error, extra, agg, lr, step):
         cfg, spec = self.cfg, self.spec
         dampen = self.resolved_dampening()
         rho = cfg.virtual_momentum
+        agg, momentum, error = map(self._up, (agg, momentum, error))
         m = rho * momentum + agg if rho > 0 else agg
         if cfg.error_type == "virtual":
             e = error + lr * m
             update = self.unsketch(spec, e, cfg.k)  # dense, <= k nonzeros
-            e = e - sketch_vec(spec, update)  # zero HH (linearity)
+            # zero HH (linearity); the interior re-sketch accumulates at
+            # f32 regardless of the storage dtype (_spec_acc) so the EF
+            # bank's algebra never pays a bf16 round-trip mid-round
+            e = e - sketch_vec(self._spec_acc, update)
             if cfg.error_decay != 1.0:
                 e = cfg.error_decay * e  # d/c-envelope mitigation
             delta = update
@@ -95,7 +135,7 @@ class SketchCompressor(Compressor):
                                 estimate_at(spec, m, hh_idx), 0.0)
             m = m - sketch_sparse(spec, hh_idx, m_at_hh)
         new_m = m if rho > 0 else momentum
-        return delta, new_m, e, extra
+        return delta, self._down(new_m), self._down(e), extra
 
     def server_update_sharded(self, momentum, error, extra, agg, lr, step,
                               *, axis_name, Wd, d):
@@ -118,6 +158,7 @@ class SketchCompressor(Compressor):
         rho = cfg.virtual_momentum
         S = -(-d // Wd)
         my, idx_c, in_range = self._slice_coords(axis_name, S, d)
+        agg, momentum, error = map(self._up, (agg, momentum, error))
         m = rho * momentum + agg if rho > 0 else agg
         sel, upd, e = self._slice_extract(m, error, lr, idx_c, in_range,
                                           axis_name)
@@ -138,7 +179,8 @@ class SketchCompressor(Compressor):
                 self._shard_estimate_at()(spec, m, hh_gidx), 0.0,
             )
             m = m - jax.lax.psum(
-                sketch_sparse(spec, hh_gidx, m_at_hh), axis_name
+                sketch_sparse(spec, hh_gidx, m_at_hh).astype(spec.table_dtype),
+                axis_name,
             )
         new_m = m if rho > 0 else momentum
         # compact this shard's <= k selected entries into a fixed-size
@@ -149,7 +191,7 @@ class SketchCompressor(Compressor):
         # in-range; their val is 0.0, so the apply scatter ignores them
         g_idx = jax.lax.all_gather(gidx, axis_name).reshape(-1)
         g_val = jax.lax.all_gather(val, axis_name).reshape(-1)
-        return g_idx, g_val, new_m, e, extra
+        return g_idx, g_val, self._down(new_m), self._down(e), extra
 
     @staticmethod
     def _slice_coords(axis_name, S, d):
@@ -188,8 +230,13 @@ class SketchCompressor(Compressor):
             # the <= k-pair slice sketches is still the sketch of the
             # full extracted update (linearity).
             loc, val = compact_nonzero(upd, cfg.k)
+            # the psum payload carries the STORAGE dtype (halved collective
+            # bytes under bf16 tables — and what keeps the xla_audit
+            # ledger-vs-HLO tolerance arithmetic exact); the subtraction
+            # promotes back to e's f32
             e = e - jax.lax.psum(
-                sketch_sparse(spec, idx_c[loc], val), axis_name
+                sketch_sparse(spec, idx_c[loc], val).astype(spec.table_dtype),
+                axis_name,
             )
             if cfg.error_decay != 1.0:
                 e = cfg.error_decay * e
@@ -215,8 +262,8 @@ class SketchCompressor(Compressor):
                     d, dp, S):
         cfg, spec = self.cfg, self.spec
         rho = cfg.virtual_momentum
-        table = sketch_vec(spec, local)
-        agg = jax.lax.psum(table, axis_name) / W
+        table = sketch_vec(spec, local)  # storage dtype — the psum payload
+        agg = self._up(jax.lax.psum(table, axis_name)) / W
         # each chip estimates only its own D/W coordinate range via
         # offset-indexed global hashes; the shared ``_slice_coords`` /
         # ``_slice_extract`` helpers (also the replicated engine's
@@ -224,11 +271,12 @@ class SketchCompressor(Compressor):
         # threshold + zero-HH error feedback, through the fused Pallas
         # estimate kernel when backend='pallas'
         _, idx_c, in_range = self._slice_coords(axis_name, S, d)
+        m_in, e_in = self._up(m_in), self._up(e_in)
         m = rho * m_in + agg if rho > 0 else agg
         delta_sh, _, e = self._slice_extract(m, e_in, lr, idx_c, in_range,
                                              axis_name)
         new_m = m if rho > 0 else m_in
-        return p_sh - delta_sh, new_m, e
+        return p_sh - delta_sh, self._down(new_m), self._down(e)
 
     # ---- telemetry -------------------------------------------------------
     # the dense aggregate never exists in sketch mode (device_encode runs
@@ -304,7 +352,9 @@ class SketchCompressor(Compressor):
                 return table
             dense = self.unsketch(self.spec, table, self.cfg.k)
             idx, val = compact_nonzero(dense, self.cfg.k)
-            return sketch_sparse(new.spec, idx, val)
+            return sketch_sparse(new.spec, idx, val).astype(
+                new.spec.table_dtype
+            )
 
         return move(momentum), move(error), extra
 
@@ -316,6 +366,7 @@ class SketchCompressor(Compressor):
         r, c_actual = self.spec.table_shape
         up = r * c_actual
         requested = self.cfg.num_rows * self.cfg.num_cols
+        # (bytes follow upload_bytes_per_float below: 2 under bf16 tables)
         if up > 1.25 * requested:
             import warnings
 
@@ -327,3 +378,8 @@ class SketchCompressor(Compressor):
                 stacklevel=2,
             )
         return up
+
+    def upload_bytes_per_float(self) -> int:
+        """2 when the tables — the psum payload — are stored bfloat16
+        (the collective-bytes half of the bf16-table win), else 4."""
+        return jnp.dtype(self.spec.table_dtype).itemsize

@@ -118,7 +118,13 @@ class BudgetController:
         through the same ``masked_upload_floats`` compressor hook."""
         bpr = self._bytes[rung]
         if self.masked:
-            up = 4 * self._comps[rung].masked_upload_floats(live)
+            # bytes-per-float through the compressor hook, like the
+            # ledger (ledger.py on_round): 2 B/float for bf16 sketch
+            # tables — a hardcoded 4 would double-bill those runs and
+            # fire BudgetExhaustedError at half the real budget
+            comp = self._comps[rung]
+            up = (comp.upload_bytes_per_float()
+                  * comp.masked_upload_floats(live))
             down = avail * bpr["download_bytes"]
         else:
             up, down = bpr["upload_bytes"], bpr["download_bytes"]
@@ -127,7 +133,9 @@ class BudgetController:
     def _spend(self, rung: int, live: int, avail: int) -> None:
         bpr = self._bytes[rung]
         if self.masked:
-            self.spent_up += 4 * self._comps[rung].masked_upload_floats(live)
+            comp = self._comps[rung]
+            self.spent_up += (comp.upload_bytes_per_float()
+                              * comp.masked_upload_floats(live))
             self.spent_down += avail * bpr["download_bytes"]
         else:
             self.spent_up += bpr["upload_bytes"]

@@ -383,6 +383,19 @@ class CountSketch(NamedTuple):
     # Gather/scatter-path ops (sketch_sparse, estimate_at, num_blocks>1
     # estimation) are not matmul-bound and stay backend-agnostic.
     backend: str = "einsum"
+    # STORAGE dtype of the [r, c_actual] table (distinct from ``dtype``,
+    # the matmul OPERAND dtype). float32 (default): bit-exact tables, the
+    # r1-r5 production path — every golden recording pins it. bfloat16:
+    # tables are stored/psummed/carried in bf16 while every accumulation
+    # (the in-row einsum/kernel reductions, the server momentum/error
+    # algebra) stays f32 — halving table HBM traffic and the device_encode
+    # psum's collective bytes at GPT-2 scale ([5, 5M] table: 100 MB -> 50
+    # MB per round per link). bf16 shares f32's exponent range (no
+    # overflow risk), so the cost is ~2^-8 relative rounding at each
+    # downcast; the LINEAR aggregation contract (compress/) then holds to
+    # that tolerance instead of bit-exactly (pinned by
+    # tests/test_countsketch_bf16.py). Estimation upcasts to f32 on read.
+    table_dtype: Any = jnp.float32
 
     # -- derived static geometry ------------------------------------------
     @property
@@ -481,9 +494,12 @@ class CountSketch(NamedTuple):
     def table_shape(self) -> tuple[int, int]:
         return (self.r, self.c_actual)
 
-    def empty(self, dtype=jnp.float32) -> jnp.ndarray:
-        """A zeroed sketch table (``CSVec.zero()`` analog, csvec.py ~L110)."""
-        return jnp.zeros(self.table_shape, dtype=dtype)
+    def empty(self, dtype=None) -> jnp.ndarray:
+        """A zeroed sketch table (``CSVec.zero()`` analog, csvec.py ~L110).
+        Allocated in ``table_dtype`` unless overridden."""
+        return jnp.zeros(
+            self.table_shape, dtype=self.table_dtype if dtype is None else dtype
+        )
 
     # -- per-row hash ingredients (all static-shape, derived from seed) ----
     def _row_key(self, row: int) -> np.uint32:
@@ -711,7 +727,11 @@ def sketch_vec(spec: CountSketch, v: jnp.ndarray) -> jnp.ndarray:
 
         return sketch_vec_pallas(spec, v)
     v = _scramble(spec, v.astype(jnp.float32))  # ONE block-gather, all rows
-    return jnp.stack([_sketch_one_row(spec, v, r) for r in range(spec.r)])
+    table = jnp.stack([_sketch_one_row(spec, v, r) for r in range(spec.r)])
+    # rows accumulate in f32 (preferred_element_type above); only the
+    # FINAL table downcasts to the storage dtype (a no-op for the f32
+    # default — convert_element_type to the same dtype folds away)
+    return table.astype(spec.table_dtype)
 
 
 def sketch_add_vec(spec: CountSketch, table: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
@@ -726,8 +746,10 @@ def table_sqnorm_estimate(table: jnp.ndarray) -> jnp.ndarray:
     4-universal), and the median over rows tames collision outliers — the
     classic AMS/CountSketch F2 estimator. Free relative to an unsketch: no
     estimate pass, no [d] transient. Used by the telemetry diagnostics
-    (sketch-mode norm scalars, the replicated AND FSDP rounds)."""
-    return jnp.median(jnp.sum(jnp.square(table), axis=1))
+    (sketch-mode norm scalars, the replicated AND FSDP rounds). The f32
+    upcast matters for bf16-stored tables: a bf16 sum-of-squares would lose
+    the estimate to accumulation rounding (a no-op for the f32 default)."""
+    return jnp.median(jnp.sum(jnp.square(table.astype(jnp.float32)), axis=1))
 
 
 def _estimate_one_row(spec: CountSketch, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
@@ -845,7 +867,9 @@ def estimate_at(spec: CountSketch, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.
 
     def one_row(row: int):
         cols, sign = _row_cols_signs(spec, idx, row)
-        return table[row, cols] * sign
+        # explicit f32 read: bf16-stored tables estimate in f32 (no-op
+        # for the f32 default)
+        return table[row, cols].astype(jnp.float32) * sign
 
     ests = jnp.stack([one_row(r) for r in range(spec.r)])
     return _median_rows(ests)
@@ -869,6 +893,53 @@ def sketch_sparse(spec: CountSketch, idx: jnp.ndarray, vals: jnp.ndarray) -> jnp
         return jnp.zeros((spec.c_actual,), jnp.float32).at[cols].add(vals * sign)
 
     return jnp.stack([one_row(r) for r in range(spec.r)])
+
+
+def sketch_segment(spec: CountSketch, offset: int, vals: jnp.ndarray) -> jnp.ndarray:
+    """Sketch the contiguous flat-[d] segment ``[offset, offset + n)``
+    given its values (any shape; raveled) — the per-leaf building block of
+    the sketch-fused backward. ``offset`` is STATIC (a python int: each
+    param leaf's position in the ``ravel_pytree`` layout). Same hash
+    mapping as ``sketch_sparse`` at ``idx = offset + arange(n)``, so by
+    linearity the sum of every leaf's segment sketch IS the sketch of the
+    full flat gradient — without the [d] concat ever existing."""
+    flat = vals.reshape(-1).astype(jnp.float32)
+    idx = jnp.uint32(int(offset)) + jnp.arange(flat.shape[0], dtype=jnp.uint32)
+    return sketch_sparse(spec, idx, flat)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def sketch_grad_tap(spec: CountSketch, offset: int, leaf, table):
+    """Identity on ``leaf`` whose TRANSPOSE sketches the leaf's cotangent.
+
+    The sketch-fused backward (parallel/round.py make_sketch_grad_one):
+    thread every param leaf through a tap that shares one dummy zeros
+    ``table`` [r, c_actual] f32, then differentiate the loss w.r.t. that
+    table — each tap's backward rule emits
+    ``sketch_segment(spec, offset, dL/dleaf)`` as the table's cotangent,
+    JAX's fan-in accumulation sums them, and the result is the sketch of
+    the full flat gradient. The per-leaf cotangents are consumed where AD
+    produces them; ``ravel_pytree``'s flat [D] concat (the transpose of
+    ``unravel``) is never traced because the params vector itself is not
+    differentiated. Forward is the identity on ``leaf`` (the zeros table
+    contributes nothing), so the loss value is untouched."""
+    del table
+    return leaf
+
+
+def _sketch_grad_tap_fwd(spec, offset, leaf, table):
+    del table
+    return leaf, None
+
+
+def _sketch_grad_tap_bwd(spec, offset, _res, ct):
+    # leaf cotangent passes through untouched (correct if a caller also
+    # differentiates the params; unused -> DCE'd); the table cotangent is
+    # this leaf's segment sketch
+    return ct, sketch_segment(spec, offset, ct)
+
+
+sketch_grad_tap.defvjp(_sketch_grad_tap_fwd, _sketch_grad_tap_bwd)
 
 
 def unsketch_sparse(

@@ -242,10 +242,14 @@ def _sketch_row(spec, v_s: jnp.ndarray, row: int) -> jnp.ndarray:
 
 
 def sketch_vec_pallas(spec, v: jnp.ndarray) -> jnp.ndarray:
-    """Pallas backend of ``sketch_vec`` — same table, kernel-tiled."""
+    """Pallas backend of ``sketch_vec`` — same table, kernel-tiled. Rows
+    accumulate in f32 inside the kernels; only the final table downcasts
+    to ``spec.table_dtype`` (a no-op for the f32 default), mirroring the
+    einsum backend."""
     _check_poly4_field(spec)
     v_s = _scramble(spec, v.astype(jnp.float32))  # ONE block-gather, all rows
-    return jnp.stack([_sketch_row(spec, v_s, r) for r in range(spec.r)])
+    table = jnp.stack([_sketch_row(spec, v_s, r) for r in range(spec.r)])
+    return table.astype(spec.table_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -264,6 +268,7 @@ def _estimate_row(spec, table_row: jnp.ndarray, row: int) -> jnp.ndarray:
     # windows stack: tile i reads row positions [i*TC*s, i*TC*s + TB) — the
     # only overlapping-window view; one small gather outside the kernel
     # keeps every BlockSpec plainly blocked.
+    table_row = table_row.astype(jnp.float32)  # bf16-stored tables read f32
     row_len = (g["nc_pad"] + u - 1) * s
     row_p = jnp.pad(table_row[: min(table_row.shape[0], row_len)],
                     (0, max(0, row_len - table_row.shape[0])))

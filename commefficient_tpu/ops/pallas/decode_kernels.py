@@ -9,34 +9,53 @@ plus an [r, S] stack that round-trips HBM into the median — and the hash
 index/sign vectors are themselves materialized [S] intermediates.
 
 ``estimate_at_pallas`` fuses the whole thing into ONE kernel: a grid over
-coordinate tiles keeps the sketch table resident in VMEM, generates each
-row's columns and signs on the fly from the scrambled position (uint32
-arithmetic only — the same ``_row_cols_signs`` mapping, bit-identical on
-the shared geometry), gathers the r bucket values, and runs the
-median-of-r compare-exchange network in-registers before writing its [TS]
-output tile. The per-shard [r, S] estimate stack never exists in HBM;
-only the final [S] median does (the threshold-count bisection that
-follows streams that — S = D/W per chip, not D).
+coordinate tiles generates each row's columns and signs on the fly from
+the scrambled position (uint32 arithmetic only — the same
+``_row_cols_signs`` mapping, bit-identical on the shared geometry),
+gathers the r bucket values, and runs the median-of-r compare-exchange
+network before writing its [TS] output tile. The per-shard [r, S]
+estimate stack never exists in HBM; only the final [S] median does (the
+threshold-count bisection that follows streams that — S = D/W per chip,
+not D).
 
-Scope guard: the table must fit VMEM (``r * c_actual * 4`` bytes against
-``VMEM_TABLE_BYTES``). When it does not — e.g. the GPT-2 5x5M table — the
-wrapper falls back to the plain ``estimate_at`` gather path at trace
-time, so callers can dial ``backend='pallas'`` unconditionally. On CPU
-hosts every kernel runs under Pallas interpret mode (tier-1 parity tests);
-on a TPU backend the same calls compile through Mosaic.
+VMEM-blockwise tables (the GPT-2-scale change): a table whose
+``r * c_actual * 4`` bytes fit ``VMEM_TABLE_BYTES`` is resident as ONE
+block for the whole grid (the original fast path — loaded once, every
+coordinate tile reads it in place). A larger table — the GPT-2 5x5M
+f32 table is ~100 MB against ~16 MiB of VMEM — is CHUNKED over column
+blocks: the grid gains a second (minor) dimension over ``nb`` column
+blocks, each [r, CB] block streams through VMEM in turn, and every
+coordinate tile accumulates its per-row estimates across blocks in a
+VMEM scratch buffer (each coordinate's column lands in exactly one
+block per row, so the masked accumulation is BIT-equal to the gather —
+a sum of one value and zeros). The pre-blockwise code SILENTLY fell
+back to the unfused gather path above the guard, which made the fused
+kernel inert at exactly the scale it was built for; now the blocked
+path engages instead and a one-time log line (``logging.info``) records
+the table bytes, the single-block budget, and the block count.
+
+Scope note: the sketch-accumulate and transposed-estimate kernels
+(countsketch_kernels.py) were already VMEM-blocked by construction —
+they tile over chunk/offset tiles and never hold the [r, c] table —
+so the decode-side estimate kernel was the only guard left to lift.
 
 Only the scramble-position lookup (one [S] gather over the static inverse
 block permutation) stays outside the kernel, exactly like the layout
 permutations stay outside the sketch/estimate kernels in
 countsketch_kernels.py — keeping them shared guarantees every backend
-uses one geometry.
+uses one geometry. On CPU hosts every kernel runs under Pallas interpret
+mode (tier-1 parity tests); on a TPU backend the same calls compile
+through Mosaic.
 """
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from commefficient_tpu.ops.countsketch import (
     _GOLDEN,
@@ -45,17 +64,26 @@ from commefficient_tpu.ops.countsketch import (
     _mix32,
     _poly4_u32,
     _scrambled_pos,
-    estimate_at,
 )
 from commefficient_tpu.ops.pallas.countsketch_kernels import (
     _check_poly4_field,
     _interpret,
 )
 
-# VMEM budget for the resident [r, c_actual] table (v5e cores have ~16 MiB
-# of VMEM; leave headroom for the tile buffers + accumulators). Above this
-# the wrapper falls back to the unfused gather path.
+logger = logging.getLogger(__name__)
+
+# VMEM budget for a SINGLE resident table block (v5e cores have ~16 MiB of
+# VMEM; leave headroom for the tile buffers + the blockwise accumulator).
+# Tables at or under it stay resident as one block for the whole grid;
+# larger tables stream through VMEM in column blocks of at most this many
+# bytes (the blockwise path below) — there is no fallback to the unfused
+# gather path any more.
 VMEM_TABLE_BYTES = 12 << 20
+
+# one-time blockwise-engagement log per table geometry (discoverability:
+# the pre-blockwise guard fell back SILENTLY, so GPT-2 users never learned
+# why the fused kernel was inert)
+_blockwise_logged: set = set()
 
 
 def _row_static(spec, row: int):
@@ -93,38 +121,119 @@ def _row_col_sign(spec, row: int, spos: jnp.ndarray):
     return chunk * s + h, sign
 
 
+def _column_block(spec) -> int:
+    """Column-block width CB for the blockwise path: the largest multiple
+    of 128 whose [r, CB] f32 block fits the single-block VMEM budget
+    (floored at 128 so degenerate geometries still make progress)."""
+    r = spec.table_shape[0]
+    return max(128, (VMEM_TABLE_BYTES // (r * 4)) // 128 * 128)
+
+
 def estimate_at_pallas(spec, table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """Fused median-of-rows point estimates for a coordinate subset —
     drop-in for ``estimate_at`` (same values to fp32 rounding; bit-equal
-    under interpret mode, pinned by tests/test_sketch_decode.py). Falls
-    back to the unfused gather path when the table exceeds the VMEM guard."""
+    under interpret mode, pinned by tests/test_sketch_decode.py and the
+    blockwise parity tests in tests/test_decode_blockwise.py). Tables over
+    the single-block VMEM budget stream through VMEM in column blocks
+    (module docstring) instead of falling back to the gather path."""
     r, c_actual = spec.table_shape
-    if r * c_actual * 4 > VMEM_TABLE_BYTES:
-        return estimate_at(spec, table, idx)
     _check_poly4_field(spec)
     n = idx.shape[0]
     TS = min(4096, _ceil_mult(max(n, 1), 128))
     n_pad = _ceil_mult(max(n, 1), TS)
     spos = _scrambled_pos(spec, idx.astype(jnp.uint32))
     spos = jnp.pad(spos, (0, n_pad - n)).reshape(1, n_pad)
+    # bf16-stored tables upcast AT THE GATHER inside the kernel (a no-op
+    # for f32): a whole-table .astype here would materialize a second
+    # full-size f32 copy in HBM at exactly the above-VMEM scale the
+    # blockwise path exists for, and double the bytes streamed
 
-    def kernel(spos_ref, table_ref, out_ref):
+    if r * c_actual * 4 <= VMEM_TABLE_BYTES:
+        # single-block fast path: the whole table VMEM-resident across
+        # every coordinate tile (the pre-blockwise kernel, unchanged)
+        def kernel(spos_ref, table_ref, out_ref):
+            sp = spos_ref[0, :].astype(jnp.uint32)
+            ests = []
+            for row in range(spec.r):
+                cols, sign = _row_col_sign(spec, row, sp)
+                ests.append(
+                    table_ref[row, :][cols].astype(jnp.float32) * sign
+                )
+            out_ref[0, :] = _median_rows(jnp.stack(ests))
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_pad // TS,),
+            in_specs=[
+                pl.BlockSpec((1, TS), lambda i: (0, i)),
+                pl.BlockSpec((r, c_actual), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, TS), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            interpret=_interpret(),
+        )(spos, table)
+        return out[0, :n]
+
+    # -- blockwise path: table streamed through VMEM in column blocks ------
+    CB = _column_block(spec)
+    c_pad = _ceil_mult(c_actual, CB)
+    nb = c_pad // CB
+    key = (r, c_actual)
+    if key not in _blockwise_logged:
+        _blockwise_logged.add(key)
+        logger.info(
+            "estimate_at_pallas: [%d, %d] table (%.1f MiB) exceeds the "
+            "single-block VMEM budget (%.0f MiB) — streaming it through "
+            "VMEM in %d column blocks of %d (blockwise fused kernel; the "
+            "pre-blockwise guard silently fell back to the unfused gather "
+            "path here)",
+            r, c_actual, r * c_actual * 4 / 2**20,
+            VMEM_TABLE_BYTES / 2**20, nb, CB,
+        )
+    # no host-side pad to c_pad: Pallas accepts the non-divisible tail
+    # block (a whole-table pad would COPY the ~100 MB table once per
+    # call); the tail's out-of-range lanes are never read meaningfully —
+    # every in_blk lane's column is < c_actual by construction, and
+    # masked lanes gather block position 0 (the block's first real
+    # column) before jnp.where discards them.
+
+    def kernel(spos_ref, table_ref, out_ref, acc_ref):
+        # grid = (coordinate tiles, column blocks), blocks minor: for one
+        # coordinate tile, j sweeps every column block while the [r, TS]
+        # scratch accumulates each row's (single) in-block contribution.
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
         sp = spos_ref[0, :].astype(jnp.uint32)
-        ests = []
+        base = j * CB
         for row in range(spec.r):
             cols, sign = _row_col_sign(spec, row, sp)
-            ests.append(table_ref[row, :][cols] * sign)
-        out_ref[0, :] = _median_rows(jnp.stack(ests))
+            local = cols - base
+            in_blk = (local >= 0) & (local < CB)
+            safe = jnp.where(in_blk, local, 0)
+            vals = table_ref[row, :][safe].astype(jnp.float32)
+            # exactly one block satisfies in_blk per (coordinate, row), so
+            # the accumulated sum is value + zeros — BIT-equal to the
+            # direct gather, not a float-reassociation approximation
+            acc_ref[row, :] += jnp.where(in_blk, vals * sign, 0.0)
+
+        @pl.when(j == nb - 1)
+        def _emit():
+            out_ref[0, :] = _median_rows(acc_ref[...])
 
     out = pl.pallas_call(
         kernel,
-        grid=(n_pad // TS,),
+        grid=(n_pad // TS, nb),
         in_specs=[
-            pl.BlockSpec((1, TS), lambda i: (0, i)),
-            pl.BlockSpec((r, c_actual), lambda i: (0, 0)),
+            pl.BlockSpec((1, TS), lambda i, j: (0, i)),
+            pl.BlockSpec((r, CB), lambda i, j: (0, j)),
         ],
-        out_specs=pl.BlockSpec((1, TS), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((1, TS), lambda i, j: (0, i)),
         out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((r, TS), jnp.float32)],
         interpret=_interpret(),
-    )(spos, table.astype(jnp.float32))
+    )(spos, table)
     return out[0, :n]

@@ -269,6 +269,11 @@ class FederatedSession:
                 hash_family=rcfg.hash_family,
                 m=rcfg.sketch_m,
                 backend=rcfg.sketch_backend,
+                table_dtype=(
+                    jnp.bfloat16
+                    if rcfg.sketch_table_dtype == "bfloat16"
+                    else jnp.float32
+                ),
             )
             if (
                 rcfg.sketch_backend == "pallas"
@@ -442,8 +447,12 @@ class FederatedSession:
             if rung.cfg.do_topk_down
             else rung.compressor.download_floats()
         )
+        # uplink bytes go through the compressor's bytes-per-float hook
+        # (2 for bf16 sketch tables — the psum payload really is half);
+        # the downlink stays the conservative 4 B/float dense broadcast
         return {"upload_floats": up, "download_floats": down,
-                "upload_bytes": 4 * up, "download_bytes": 4 * down}
+                "upload_bytes": rung.compressor.upload_bytes_per_float() * up,
+                "download_bytes": 4 * down}
 
     # -- rung prewarm (AOT trace of every rung's round program) ------------
     def _rung_state_struct(self, rung: _Rung):
@@ -473,7 +482,7 @@ class FederatedSession:
                     return jax.ShapeDtypeStruct((dp,), jnp.float32)
                 if kind == KIND_TABLE:
                     return jax.ShapeDtypeStruct(
-                        rung.spec.table_shape, jnp.float32
+                        rung.spec.table_shape, rung.spec.table_dtype
                     )
                 return ()
 
@@ -601,9 +610,20 @@ class FederatedSession:
             rung.round_idx_fn = self._build_round_idx_fn(rung, augment)
         self._round_idx_fn = self.rungs[self.active_rung].round_idx_fn
 
-    def _build_round_idx_fn(self, rung: _Rung, augment):
+    def raw_round_idx_fn(self, rung: Optional[_Rung] = None, augment=None):
+        """The UNJITTED index-round closure
+        ``(state, data, client_ids, idx, plan, lr, env=()) -> (state,
+        metrics)`` — the traceable body both the jitted per-round program
+        (``_build_round_idx_fn``) and the scan-over-rounds engine's
+        ``lax.scan`` body (pipeline/scan_engine.py) wrap, so the two
+        dispatch granularities share one round trace by construction.
+        Defaults to the active rung and the attached augmenter."""
         from commefficient_tpu.parallel.round import build_round_fn as _brf
 
+        if rung is None:
+            rung = self.rungs[self.active_rung]
+        if augment is None:
+            augment = self._dev_augment
         raw_round = _brf(
             rung.cfg, self._loss_fn, self.unravel, self.mesh, rung.spec,
             _jit=False, d=self.grad_size,
@@ -627,6 +647,10 @@ class FederatedSession:
                 }
             return raw_round(state, client_ids, batch, lr, env=env)
 
+        return round_idx_fn
+
+    def _build_round_idx_fn(self, rung: _Rung, augment):
+        round_idx_fn = self.raw_round_idx_fn(rung, augment)
         # the retrace sentinel watches the OUTER jitted program (the raw
         # round inside it is traced as part of the same trace — hooking
         # both would double-count every legitimate compile)

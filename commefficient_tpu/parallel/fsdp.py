@@ -137,7 +137,9 @@ def init_fsdp_state(
         if kind == KIND_DENSE:
             return jnp.zeros((dp,), f32)
         if kind == KIND_TABLE:
-            return jnp.zeros(spec.table_shape, f32)
+            # replicated tables carry the spec's storage dtype (bf16
+            # halves per-chip table HBM; f32 default unchanged)
+            return jnp.zeros(spec.table_shape, spec.table_dtype)
         return ()
 
     state = FedState(

@@ -153,7 +153,12 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
     def grad_one(params_vec, batch, noise_rng):
         params = unravel(params_vec)
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
-        g, _ = ravel_pytree(grads)
+        # named_scope marker (no ops added): the scope name survives into
+        # the compiled HLO's op metadata, so the sketch-fused-backward
+        # tests can pin that THEIR lowered round contains no flat [D]
+        # gradient concat (tests/test_sketch_fused_bwd.py)
+        with jax.named_scope("flat_grad_concat"):
+            g, _ = ravel_pytree(grads)
         g = g.astype(f32)
         g = grad_extra_axes_psum(g, mesh, WORKERS)
         if cfg.weight_decay:
@@ -166,6 +171,74 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
         return g, loss, aux
 
     return grad_one
+
+
+def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
+                         mesh, spec: CountSketch, *, d: int):
+    """Sketch-FUSED twin of ``make_grad_one`` for the fused flattened-batch
+    path: ``(params_vec, batch, noise_rng) -> (grad TABLE [r, c_actual]
+    f32, loss, aux)``.
+
+    Every param leaf is threaded through ``ops.countsketch.sketch_grad_tap``
+    (a custom_vjp identity sharing one dummy zeros table), and the loss is
+    differentiated w.r.t. THAT TABLE: each tap's backward rule sketches
+    its leaf's cotangent into the table where AD produces it
+    (``sketch_segment`` at the leaf's static ravel_pytree offset), and
+    JAX's cotangent fan-in sums them — by linearity the result is the
+    sketch of the full flat gradient, while the flat [D] concat (the
+    transpose of ``unravel``, ~500 MB at GPT-2 scale) is never traced:
+    the params vector itself is not differentiated. Weight decay composes
+    by the same linearity as one matmul-path sketch of the (already
+    materialized) params vector. Gates (validated by Config): no clip, no
+    DP noise, no local momentum, no fedsim — exactly the fused-path
+    conditions, where one gradient per device exists.
+    """
+    from commefficient_tpu.ops.countsketch import (
+        sketch_grad_tap,
+        sketch_vec,
+    )
+
+    # static per-leaf offsets of the ravel_pytree flat layout (jax.tree
+    # leaf order == ravel_pytree order)
+    import math
+
+    leaf_structs = jax.tree.leaves(
+        jax.eval_shape(unravel, jax.ShapeDtypeStruct((d,), jnp.float32))
+    )
+    sizes = [math.prod(s.shape) if s.shape else 1 for s in leaf_structs]
+    offsets = [0]
+    for sz in sizes[:-1]:
+        offsets.append(offsets[-1] + sz)
+
+    def grad_one_table(params_vec, batch, noise_rng):
+        del noise_rng  # DP noise is a [D]-vector draw — gated off this path
+
+        def tapped(table):
+            params = unravel(params_vec)
+            leaves, treedef = jax.tree.flatten(params)
+            tapped_leaves = [
+                sketch_grad_tap(spec, off, leaf, table)
+                for off, leaf in zip(offsets, leaves)
+            ]
+            return loss_fn(jax.tree.unflatten(treedef, tapped_leaves), batch)
+
+        zeros = jnp.zeros(spec.table_shape, jnp.float32)
+        (loss, aux), table = jax.value_and_grad(tapped, has_aux=True)(zeros)
+        # TP/SP meshes on pre-vma JAX: the explicit total over the extra
+        # axes commutes with the (linear) sketch, so totaling the TABLE
+        # is totaling the gradient (no-op on vma JAX / workers-only mesh)
+        table = grad_extra_axes_psum(table, mesh, WORKERS)
+        if cfg.weight_decay:
+            # sketch(g + wd*p) = sketch(g) + wd * sketch(p); the [D]
+            # params vector already exists as state, so its sketch takes
+            # the matmul path (f32 accumulation — _replace keeps interior
+            # algebra f32 under bf16 table storage)
+            table = table + cfg.weight_decay * sketch_vec(
+                spec._replace(table_dtype=jnp.float32), params_vec
+            )
+        return table, loss, aux
+
+    return grad_one_table
 
 
 def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *,
@@ -287,6 +360,25 @@ def build_round_fn(
         and not use_fedsim
     )
 
+    # sketch-FUSED backward (cfg.sketch_fused_bwd): the fused path's one
+    # gradient per device is produced directly as an encoded sketch table
+    # by per-leaf custom_vjp taps — the flat [D] grad concat is never
+    # traced (make_sketch_grad_one). Config validated every gate at
+    # construction; this assert is the defense against a future gate
+    # drifting out of sync with the validation.
+    sketch_fused = bool(cfg.sketch_fused_bwd)
+    if sketch_fused and not (fused and comp.supports_fused_backward):
+        raise ValueError(
+            "sketch_fused_bwd requires the fused flattened-batch path and "
+            f"a fused-backward-capable compressor (mode={cfg.mode!r}, "
+            f"fused={fused}) — Config validation should have caught this"
+        )
+    grad_table_one = (
+        make_sketch_grad_one(cfg, loss_fn, unravel, mesh, spec, d=d)
+        if sketch_fused
+        else None
+    )
+
     # ---- the shard body: this IS the worker process ----------------------
     def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng,
                      lr, *fs):
@@ -337,7 +429,24 @@ def build_round_fn(
             return transmit, new_vel, new_err, loss, aux
 
         w_loc = client_ids.shape[0]
-        if fused:
+        if fused and sketch_fused:
+            # the gradient IS the table: per-leaf cotangent sketches
+            # accumulated during the backward pass (no flat [D] grad, no
+            # separate device_encode sketch pass). Same flattened-batch
+            # identity as sum_client_grads' fused branch: w_loc * the
+            # flat-batch gradient's sketch == the sketch of the summed
+            # client transmits, by linearity.
+            flat = jax.tree.map(
+                lambda a: a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:]),
+                batch,
+            )
+            with jax.named_scope("sketch_fused_bwd"):
+                table, loss_flat, aux = grad_table_one(params_vec, flat, rng)
+            local = comp.encode_grad_table(w_loc * table)
+            loss_local = w_loc * loss_flat
+            new_vel = jnp.zeros((w_loc, 1), f32)
+            new_err = jnp.zeros((w_loc, 1), f32)
+        elif fused:
             local, loss_local, aux = sum_client_grads(
                 grad_one, params_vec, batch, client_ids, rng, fused=True
             )
@@ -356,7 +465,8 @@ def build_round_fn(
             local = jnp.sum(transmit, axis=0)
             loss_local = jnp.sum(loss)
             aux = jax.tree.map(lambda a: jnp.sum(a, 0), aux)
-        local = comp.device_encode(local)  # linear -> psum below is exact
+        if not (fused and sketch_fused):  # fused-bwd already encoded above
+            local = comp.device_encode(local)  # linear -> psum is exact
         agg = jax.lax.psum(local, WORKERS) / W
         loss_mean = jax.lax.psum(loss_local, WORKERS) / W
         aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)

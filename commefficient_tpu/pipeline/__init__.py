@@ -17,6 +17,12 @@ result — every rng stream in this repo is a pure function of
     window and the determinism contracts (controller barrier order,
     policy-lag rule, checkpoint fencing, crash draining); see its module
     docstring.
+  * ``scan_engine``: ``ScanRounds`` — the orthogonal dispatch-side
+    amortization (``--scan_rounds K``): K rounds per XLA dispatch via
+    ``lax.scan`` over the device-resident index round, sampler indices
+    staged per EPOCH, telemetry packs stacked and drained at scan exit;
+    blocks chop at every state-observation boundary so K > 1 is pinned
+    equal to K = 1 on params and the drained scalar sequence.
 
 ``--pipeline_depth 0`` (default) constructs NOTHING: the train loops keep
 the legacy synchronous path, golden parity recordings and level-0 HLO are
@@ -35,10 +41,12 @@ from commefficient_tpu.pipeline.prefetch import (
     RoundPrefetcher,
     RoundWork,
 )
+from commefficient_tpu.pipeline.scan_engine import ScanRounds
 
 __all__ = [
     "PipelinedRounds",
     "PrefetchWorkerDied",
     "RoundPrefetcher",
     "RoundWork",
+    "ScanRounds",
 ]

@@ -151,7 +151,10 @@ class CommLedger:
         down = bpr["download_bytes"]
         if self.masked:
             live, avail = self._counts(scalars)
-            up = (4 * comp.masked_upload_floats(live)
+            # bytes-per-float through the compressor hook so bf16-table
+            # payloads (2 B/float) keep the exactness invariant
+            up = (comp.upload_bytes_per_float()
+                  * comp.masked_upload_floats(live)
                   if comp is not None else live * up)
             down = avail * down
             self.live_client_rounds += live

@@ -209,7 +209,23 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
         # AFTER the restore so the prefetcher starts at the resumed step
         # (its inputs are pure functions of the round index, so the
         # staged stream is the uninterrupted run's).
-        if cfg.pipeline_enabled:
+        if cfg.scan_rounds > 1:
+            # scan-over-rounds engine (pipeline/scan_engine.py): K rounds
+            # per XLA dispatch on the device-resident index path; mutually
+            # exclusive with pipeline_depth / the control plane (Config
+            # validated). Built AFTER the restore like the pipelined
+            # engine — its staging is a pure function of the round index.
+            from commefficient_tpu.pipeline import ScanRounds
+
+            engine = ScanRounds(
+                cfg, session, sampler, lr_fn, num_rounds,
+                steps_per_epoch=steps_per_epoch, spans=spans,
+                profiler=profiler,
+            ).start(step)
+            print(f"scan engine: up to {cfg.scan_rounds} rounds/dispatch "
+                  "(device-resident lax.scan; pinned equal to per-round "
+                  "dispatch on params and drained scalars)")
+        elif cfg.pipeline_enabled:
             from commefficient_tpu.pipeline import PipelinedRounds
 
             engine = PipelinedRounds(

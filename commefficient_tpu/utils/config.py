@@ -197,6 +197,29 @@ class Config:
     # already bf16-pass and the round is not matmul-bound) — kept as an
     # explicit knob for hardware where it matters.
     sketch_dtype: str = "float32"
+    # Sketch table STORAGE dtype ("float32" | "bfloat16") — distinct from
+    # sketch_dtype (the matmul OPERAND dtype above). "bfloat16" stores
+    # and psums the [r, c] tables in bf16 while every accumulation (the
+    # in-row reductions, the server momentum/error algebra) stays f32:
+    # table HBM traffic and the device_encode psum's collective bytes
+    # halve (100 MB -> 50 MB per round per link at the GPT-2 5x5M
+    # geometry), at ~2^-8 relative rounding per downcast — the compress/
+    # LINEAR contract then holds to that pinned tolerance instead of
+    # bit-exactly (tests/test_countsketch_bf16.py). "float32" (default)
+    # is bit-untouched: every golden recording pins it.
+    sketch_table_dtype: str = "float32"
+    # Sketch-FUSED backward (parallel/round.py make_sketch_grad_one):
+    # per-leaf custom_vjp taps sketch each param leaf's cotangent
+    # directly into the [r, c] table during the backward pass, so
+    # make_grad_one's ravel_pytree flat [D] grad — a 500 MB transient at
+    # GPT-2 scale — is NEVER materialized in sketch mode (the compiled
+    # round is pinned free of the flat_grad_concat marker). Linearity
+    # makes it exact up to float summation order (pinned tolerance, not
+    # bit-equal — hence opt-in; the default keeps golden parity
+    # bit-untouched). Requires the fused flattened-batch path: mode=
+    # sketch, fuse_clients, no local momentum/clip/DP-noise/fedsim
+    # (validated at construction).
+    sketch_fused_bwd: bool = False
     # CountSketch banded-bucket width (ops/countsketch.py v5): each chunk's
     # collision pool is band*stride buckets; larger = closer to classic
     # sketch statistics (stabler FetchSGD feedback), smaller = cheaper
@@ -328,6 +351,20 @@ class Config:
     # order, and checkpoint saves fence the window (README "Pipelined
     # round execution" documents the determinism contract).
     pipeline_depth: int = 0
+    # Scan-over-rounds device-resident execution (pipeline/scan_engine.py):
+    # K > 1 executes K rounds per XLA dispatch via ``lax.scan`` on the
+    # device-resident index path — sampler indices staged per EPOCH (one
+    # H2D for the whole epoch's [spe, W, B] draws), telemetry packs
+    # stacked by the scan and drained at scan exit, per-round python
+    # dispatch overhead amortized K-fold. Blocks are CHOPPED at every
+    # point the synchronous loop would act on state (epoch end,
+    # checkpoint_every, snapshot_every, controller... see the engine
+    # docstring), so the drained scalar sequence and the params are
+    # pinned equal to K=1. 0/1 (default): the per-round dispatch path,
+    # bit-untouched. Requires device_data (the index round) and is
+    # mutually exclusive with the control plane, pipeline_depth and
+    # preemption sources (validated at construction / train entry).
+    scan_rounds: int = 0
 
     # --- adaptive communication budget (commefficient_tpu/control/;
     # TPU-native — the reference fixes k/num_cols/rank once per run) ---
@@ -522,6 +559,13 @@ class Config:
             raise ValueError(
                 f"sketch_dtype must be float32|bfloat16, got {self.sketch_dtype!r}"
             )
+        if self.sketch_table_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "sketch_table_dtype must be float32|bfloat16, "
+                f"got {self.sketch_table_dtype!r}"
+            )
+        self._validate_sketch_fused_bwd()
+        self._validate_scan_rounds()
         if self.num_workers % self.num_devices != 0:
             raise ValueError(
                 "num_workers must be divisible by num_devices "
@@ -595,6 +639,99 @@ class Config:
             )
         self._validate_control()
         self._validate_resilience()
+
+    def _validate_sketch_fused_bwd(self) -> None:
+        """The sketch-fused backward produces the gradient directly as an
+        encoded table, so it only exists on the fused flattened-batch
+        path with nothing per-[D] configured — every blocker is named
+        here at construction instead of at first trace."""
+        if not self.sketch_fused_bwd:
+            return
+        if self.mode != "sketch":
+            raise ValueError(
+                "sketch_fused_bwd sketches per-leaf cotangents into the "
+                f"CountSketch table; mode={self.mode!r} has no table — "
+                "drop the flag or use mode='sketch'"
+            )
+        if not self.fuse_clients:
+            raise ValueError(
+                "sketch_fused_bwd needs the fused flattened-batch path "
+                "(ONE gradient per device -> one table); with "
+                "fuse_clients=False each client's grad would pay its own "
+                "sketch — set fuse_clients=True"
+            )
+        if self.local_momentum > 0:
+            raise ValueError(
+                "sketch_fused_bwd is incompatible with local_momentum: "
+                "per-client velocity needs the dense per-client gradient "
+                "the fused backward never materializes"
+            )
+        if self.max_grad_norm is not None:
+            raise ValueError(
+                "sketch_fused_bwd is incompatible with max_grad_norm "
+                "(clipping also forces the per-client vmap path; the "
+                "fused-batch gate already excludes it)"
+            )
+        if self.dp_noise_multiplier > 0:
+            raise ValueError(
+                "sketch_fused_bwd is incompatible with DP noise: the "
+                "noise is a [D]-vector draw, which is exactly the "
+                "transient the fused backward exists to avoid"
+            )
+        if self.fedsim_enabled:
+            raise ValueError(
+                "sketch_fused_bwd needs the fused flattened-batch path, "
+                "and fedsim masking is inherently per-client (it forces "
+                "the vmap path) — run one or the other"
+            )
+
+    def _validate_scan_rounds(self) -> None:
+        """Scan-over-rounds flags (pipeline/scan_engine.py). The engine
+        executes K rounds per dispatch, so anything that must act
+        host-side BETWEEN two arbitrary rounds is incompatible and
+        refused here; boundaries the engine can honor by CHOPPING blocks
+        (checkpoints, snapshots, epoch ends) need no constraint."""
+        if self.scan_rounds < 0:
+            raise ValueError(
+                f"scan_rounds must be >= 0 (0/1 = per-round dispatch), "
+                f"got {self.scan_rounds}"
+            )
+        if self.scan_rounds <= 1:
+            return
+        if not self.device_data:
+            raise ValueError(
+                "scan_rounds > 1 runs the device-resident index round "
+                "inside lax.scan — the epoch's batches must already be "
+                "in HBM; set device_data=True (host-batch rounds would "
+                "serialize on H2D anyway)"
+            )
+        if self.offload_client_state or self.fsdp:
+            raise ValueError(
+                "scan_rounds > 1 needs the device-resident index path, "
+                "which excludes offload_client_state/fsdp (host-resident "
+                "rows cross PCIe between rounds)"
+            )
+        if self.control_enabled:
+            raise ValueError(
+                "scan_rounds > 1 is mutually exclusive with the control "
+                "plane: the controller decides immediately-pre-dispatch "
+                "per ROUND, and a scanned block admits no host decision "
+                "between its rounds — run one or the other"
+            )
+        if self.pipeline_depth > 0:
+            raise ValueError(
+                "scan_rounds > 1 already stages the whole epoch's "
+                "sampler indices up front (a superset of the "
+                "prefetcher's depth-K window on the index path) — drop "
+                "pipeline_depth"
+            )
+        if self.preempt_signals or "preempt@" in self.chaos:
+            raise ValueError(
+                "scan_rounds > 1 cannot honor round-granular preemption: "
+                "the device state only exists at block boundaries, so a "
+                "mid-block preempt would checkpoint the wrong round — "
+                "disable preempt_signals / the preempt@ chaos event"
+            )
 
     def _validate_resilience(self) -> None:
         """Self-healing flags (resilience/). Same late-validation split as
@@ -854,7 +991,16 @@ def _add_flags(p: argparse.ArgumentParser) -> None:
                 )
             else:
                 inner = float if "float" in ann else (int if "int" in ann else str)
-                p.add_argument(name, type=inner, default=default)
+
+                def opt(s, _inner=inner):
+                    # Optional fields are resettable to None from the CLI
+                    # ("--max_grad_norm none" turns clipping off even when
+                    # an entry's defaults set it — without this, a default
+                    # like gpt2_train's max_grad_norm=1.0 was one-way and
+                    # e.g. --sketch_fused_bwd was unreachable there)
+                    return None if s.lower() in ("none", "null") else _inner(s)
+
+                p.add_argument(name, type=opt, default=default)
         else:
             p.add_argument(name, type=type(default), default=default)
 

@@ -66,6 +66,17 @@ TOLERANCES = {
     # raw throughput, so the whole family (mfu, *_mfu, *_audited_mfu)
     # gets a tighter band
     "mfu": 0.10,
+    # sketch-gap PR: the headline GPT-2 ratios divide two measurements of
+    # the same run on the same mesh (load cancels), so they get the tight
+    # band too — this is what makes the 0.6x sketch-vs-uncompressed
+    # target TRAJECTORY-enforced: once an optimized record lands, any
+    # later drop below median*(1-0.10) fails the gate. The other new
+    # gpt2_sketch_* legs (gpt2_sketch_scan_*) gate through the generic
+    # suffix rules (_tokens_per_sec/_mfu/_vs_uncompressed all UP);
+    # *_rounds_per_dispatch is configuration, not measurement —
+    # informational by having no gated suffix.
+    "gpt2_sketch_vs_uncompressed": 0.10,
+    "gpt2_sketch_scan_vs_uncompressed": 0.10,
 }
 
 # pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated

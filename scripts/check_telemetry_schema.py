@@ -201,6 +201,13 @@ def _check_pipeline_scalar(name: str, v, where: str) -> None:
             f"{where}: pipeline/staged_rounds {v} is not a non-negative "
             "integer — it counts whole staged rounds"
         )
+    if name == "pipeline/scan_rounds_per_dispatch" and (
+            v != int(v) or v < 1):
+        raise SchemaError(
+            f"{where}: pipeline/scan_rounds_per_dispatch {v} is not a "
+            "positive integer — it counts the scanned block's whole "
+            "rounds (scan engine, pipeline/scan_engine.py)"
+        )
 
 
 def _check_resilience_scalar(name: str, v, where: str) -> None:

@@ -243,6 +243,36 @@ def main():
         print("[decode fused] pallas slice skipped on non-TPU host "
               "(pass --sketch_backend pallas to force interpret mode)")
 
+    # -- sketch-fused backward phase line (sketch-gap PR) ------------------
+    # the fused path produces the grad DIRECTLY as a table (per-leaf
+    # custom_vjp cotangent sketches — no flat [D] concat, no separate
+    # sketch pass); its honest comparator is the dense path's grad +
+    # sketch_vec SUM, which is what the legacy round pays per device.
+    if not args.d:
+        from commefficient_tpu.parallel.round import make_sketch_grad_one
+        from commefficient_tpu.utils.config import Config as _Cfg
+
+        _fb_cfg = _Cfg(mode="sketch", error_type="virtual", k=k,
+                       num_rows=5, num_cols=num_cols,
+                       topk_method="threshold", fuse_clients=True,
+                       sketch_fused_bwd=True, weight_decay=0.0,
+                       num_clients=2 * workers, num_workers=workers,
+                       local_batch_size=batch)
+
+        grad_table = jax.jit(
+            make_sketch_grad_one(_fb_cfg, loss_fn, unravel, None, spec,
+                                 d=d)
+        )
+        bflat = {"x": x, "y": y}
+        dense_then_sketch = jax.jit(
+            lambda pv, xx, yy: sketch_vec(spec, fwd_bwd(pv, xx, yy))
+        )
+        timeit(f"[sketch fused-bwd] grad->table (batch {workers*batch})",
+               lambda pv, b: grad_table(pv, b, None)[0], vec, bflat,
+               reps=r)
+        timeit("[sketch fused-bwd] dense grad + sketch_vec (comparator)",
+               dense_then_sketch, vec, x, y, reps=r)
+
     print()
     for backend, (t_sk, t_est, t_unskd) in phase.items():
         total = t_modelw + t_sk + t_unskd + t_sk
@@ -333,17 +363,33 @@ def main():
             return s2, m["loss"]
         return jax.lax.scan(body, state, None, length=n)
 
-    state, losses = run_rounds(session.state)
+    tag = args.mode if args.mode != "sketch" else args.sketch_backend
+    if args.telemetry_level:
+        tag += f"+telemetry_l{args.telemetry_level}"
+    # per-round python dispatch twin FIRST (what the default train loop
+    # pays), then the scanned block — the [scan xK] delta is exactly the
+    # dispatch overhead the scan engine (pipeline/scan_engine.py,
+    # --scan_rounds) amortizes
+    state = session.state
+    for _ in range(2):  # compile + warm both donated layouts
+        state, m = round_fn(state, ids, data, jnp.float32(0.1))
+    fence(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = round_fn(state, ids, data, jnp.float32(0.1))
+    fence(m["loss"])
+    dt_loop = (time.perf_counter() - t0) / n * 1e3
+    print(f"per-round dispatch [{tag}]: {dt_loop:.2f} ms -> "
+          f"{workers * bench_batch / dt_loop * 1e3:,.0f} samples/s")
+    state, losses = run_rounds(state)
     fence(losses)
     t0 = time.perf_counter()
     state, losses = run_rounds(state)
     fence(losses)
     dt = (time.perf_counter() - t0) / n * 1e3
-    tag = args.mode if args.mode != "sketch" else args.sketch_backend
-    if args.telemetry_level:
-        tag += f"+telemetry_l{args.telemetry_level}"
-    print(f"scanned full round [{tag}]: {dt:.2f} ms -> "
-          f"{workers * bench_batch / dt * 1e3:,.0f} samples/s")
+    print(f"[scan x{n}] full round [{tag}]: {dt:.2f} ms -> "
+          f"{workers * bench_batch / dt * 1e3:,.0f} samples/s "
+          f"(dispatch overhead amortized: {dt_loop - dt:+.2f} ms/round)")
 
 
 if __name__ == "__main__":

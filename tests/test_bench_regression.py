@@ -182,6 +182,38 @@ def test_pipeline_leg_metrics_registered():
     assert mod.metric_direction("sketch_pipelined_host_stall_ms") is None
 
 
+def test_gpt2_sketch_gap_metrics_registered_and_gated(tmp_path):
+    """Sketch-gap PR: the new gpt2_sketch_* legs gate UP (tokens/s,
+    _vs_uncompressed — the 0.6x target is trajectory-enforced once an
+    optimized record lands), the headline ratios carry the tight 10%
+    band (two measurements of one run — load cancels), and the scan
+    leg's rounds_per_dispatch stays informational (configuration, not
+    measurement)."""
+    mod = _gate()
+    assert mod.metric_direction("gpt2_sketch_vs_uncompressed") == "up"
+    assert mod.metric_direction("gpt2_sketch_scan_vs_uncompressed") == "up"
+    assert mod.metric_direction("gpt2_sketch_scan_tokens_per_sec") == "up"
+    assert mod.metric_direction("gpt2_sketch_scan_mfu") == "up"
+    assert mod.metric_direction("gpt2_sketch_scan_rounds_per_dispatch") \
+        is None
+    assert mod.tolerance_for("gpt2_sketch_vs_uncompressed", 0.15) == 0.10
+    assert mod.tolerance_for("gpt2_sketch_scan_vs_uncompressed",
+                             0.15) == 0.10
+    # trajectory enforcement self-test: an optimized record (0.62) in the
+    # history, then a drop back toward the pre-PR ratio (0.29) must gate
+    good = {**BASELINE, "gpt2_sketch_vs_uncompressed": 0.62,
+            "gpt2_sketch_scan_tokens_per_sec": 90_000.0}
+    bad = {**BASELINE, "gpt2_sketch_vs_uncompressed": 0.29,
+           "gpt2_sketch_scan_tokens_per_sec": 60_000.0}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", bad)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _, _ = mod.check_regression([good], bad)
+    names = {r["metric"] for r in regs}
+    assert "gpt2_sketch_vs_uncompressed" in names
+    assert "gpt2_sketch_scan_tokens_per_sec" in names
+
+
 def test_json_summary_always_last_line(tmp_path, capsys):
     """The machine-readable summary is the last stdout line in every exit
     path (nothing-to-compare included)."""

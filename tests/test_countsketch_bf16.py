@@ -1,0 +1,206 @@
+"""bf16 sketch tables with f32 accumulation (CountSketch.table_dtype).
+
+The compress/ LINEAR contract is what makes the cross-worker psum exact:
+``encode(a) + encode(b) == encode(a + b)``. With bf16-STORED tables that
+contract holds to a pinned tolerance instead of bit-exactly (each
+downcast costs ~2^-8 relative; accumulation itself stays f32) — pinned
+here together with the properties the round engines lean on:
+
+  * linearity within tolerance (the psum-safety contract) AND the fedsim
+    masking commute (a masked client's zero transmit sketches to exactly
+    zero in any dtype);
+  * the f32 DEFAULT is bit-untouched — table dtype, values, and the
+    golden-recording path (tests/test_compress_parity.py keeps pinning
+    that end to end);
+  * estimation upcasts (bf16 table round-trips recover planted heavy
+    hitters);
+  * byte accounting: a bf16-table compressor reports 2 B/float through
+    ``upload_bytes_per_float`` and the session's bytes_per_round halves
+    the uplink — the ledger/HLO cross-check arithmetic;
+  * session-level training with bf16 tables stays close to the f32 twin
+    (loose tolerance: error feedback compounds the rounding by design).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from test_round import BASE, _setup
+
+from commefficient_tpu.compress import get_compressor
+from commefficient_tpu.data import FedSampler
+from commefficient_tpu.ops.countsketch import (
+    CountSketch,
+    estimate_all,
+    sketch_vec,
+)
+from commefficient_tpu.parallel import FederatedSession
+from commefficient_tpu.utils.config import Config
+
+D, C, R = 10_000, 2_000, 5
+
+
+def _spec(**kw):
+    return CountSketch(d=D, c=C, r=R, seed=7, **kw)
+
+
+def test_f32_default_bit_untouched():
+    """table_dtype defaults to f32 and the downcast is a no-op: the table
+    is IDENTICAL to one from a spec that never heard of table_dtype
+    (same field left at default) — the golden-parity guarantee at the
+    ops level."""
+    spec = _spec()
+    assert spec.table_dtype == jnp.float32
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    t = sketch_vec(spec, v)
+    assert t.dtype == jnp.float32
+    t_explicit = sketch_vec(_spec(table_dtype=jnp.float32), v)
+    np.testing.assert_array_equal(np.asarray(t), np.asarray(t_explicit))
+
+
+def test_bf16_linearity_within_pinned_tolerance():
+    """sketch(a) + sketch(b) vs sketch(a + b) under bf16 storage: equal
+    to within the bf16 rounding of the three downcasts — the LINEAR
+    psum-safety contract at its pinned tolerance (bit-exact would be
+    wrong to claim; a blown tolerance means accumulation left f32)."""
+    spec = _spec(table_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    ta, tb = sketch_vec(spec, a), sketch_vec(spec, b)
+    tab = sketch_vec(spec, a + b)
+    assert ta.dtype == tb.dtype == tab.dtype == jnp.bfloat16
+    lhs = np.asarray(ta, np.float32) + np.asarray(tb, np.float32)
+    rhs = np.asarray(tab, np.float32)
+    scale = np.abs(rhs).max()
+    # 3 downcasts at ~2^-8 relative each; 2e-2 * scale is ~5x headroom
+    np.testing.assert_allclose(lhs, rhs, rtol=0, atol=2e-2 * scale)
+    # and bf16 really differs from f32 (the tolerance is not vacuous)
+    f32 = np.asarray(sketch_vec(_spec(), a + b))
+    assert np.abs(rhs - f32).max() > 0
+
+
+def test_bf16_masked_zero_transmit_is_exact_zero():
+    """fedsim psum-safety: a masked-out client's zero transmit must
+    sketch to EXACTLY zero in any storage dtype (jnp.where gates the
+    transmit before the encode — zero in, zero table out), so masking
+    still commutes with the encode."""
+    spec = _spec(table_dtype=jnp.bfloat16)
+    t = sketch_vec(spec, jnp.zeros(D, jnp.float32))
+    assert np.all(np.asarray(t, np.float32) == 0.0)
+
+
+def test_bf16_roundtrip_recovers_heavy_hitters():
+    spec = _spec(table_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(2)
+    v = rng.normal(0, 1.0, size=D).astype(np.float32)
+    hh = rng.choice(D, size=10, replace=False)
+    v[hh] += 100.0 * rng.choice([-1.0, 1.0], size=10)
+    est = np.asarray(estimate_all(spec, sketch_vec(spec, jnp.asarray(v))))
+    assert est.dtype == np.float32  # estimation upcasts
+    top = np.argsort(-np.abs(est))[:32]
+    assert set(hh.tolist()) <= set(top.tolist())
+    # bf16 ulp at |v|~100 is 0.5 and collision noise at d/c=5 adds ~1-2:
+    # recovery-to-a-few-percent is the property, not fp32 accuracy
+    np.testing.assert_allclose(est[hh], v[hh], rtol=5e-2)
+
+
+def _cfg(**kw):
+    return Config(**{**BASE, "mode": "sketch", "error_type": "virtual",
+                     "virtual_momentum": 0.9, "k": 40, "num_rows": 3,
+                     "num_cols": 256, "topk_method": "threshold", **kw})
+
+
+def test_bf16_bytes_accounting_halves_uplink():
+    cfg32, cfg16 = _cfg(), _cfg(sketch_table_dtype="bfloat16")
+    d = 4096
+    comp32 = get_compressor(cfg32, d=d, spec=CountSketch(d=d, c=256, r=3))
+    comp16 = get_compressor(
+        cfg16, d=d,
+        spec=CountSketch(d=d, c=256, r=3, table_dtype=jnp.bfloat16),
+    )
+    assert comp32.upload_bytes_per_float() == 4
+    assert comp16.upload_bytes_per_float() == 2
+    assert (comp16.masked_upload_floats(5)
+            == comp32.masked_upload_floats(5))  # floats unchanged
+
+
+def test_bf16_session_bytes_and_training_close_to_f32():
+    ds, params, loss_fn = _setup(12)
+
+    def run(cfg):
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        for r in range(4):
+            ids, batch = sampler.sample_round(r)
+            m = sess.train_round(ids, batch, 0.2)
+        return sess, float(np.asarray(m["loss"]))
+
+    s32, l32 = run(_cfg())
+    s16, l16 = run(_cfg(sketch_table_dtype="bfloat16"))
+    # uplink bytes really halve; float counts identical
+    b32, b16 = s32.bytes_per_round(), s16.bytes_per_round()
+    assert b16["upload_floats"] == b32["upload_floats"]
+    assert b16["upload_bytes"] * 2 == b32["upload_bytes"]
+    # state tables carry the storage dtype
+    assert s16.state.momentum.dtype == jnp.bfloat16
+    assert s16.state.error.dtype == jnp.bfloat16
+    assert s32.state.momentum.dtype == jnp.float32
+    # training tracks the f32 twin (loose: EF compounds bf16 rounding)
+    p32 = np.asarray(s32.state.params_vec)
+    p16 = np.asarray(s16.state.params_vec)
+    scale = np.abs(p32).max()
+    assert np.abs(p32 - p16).max() < 0.1 * scale
+    assert np.isfinite(l16) and abs(l16 - l32) < 0.5
+
+
+def test_bf16_controller_masked_accounting_uses_2_bytes_per_float():
+    """The BudgetController's masked byte arithmetic promises to mirror
+    the CommLedger EXACTLY — under bf16 tables both must bill the psum
+    payload at 2 B/float (a hardcoded 4 double-billed the budget and
+    fired BudgetExhaustedError at half the real spend)."""
+    from commefficient_tpu.control import build_controller
+
+    ds, params, loss_fn = _setup(12)
+    cfg = _cfg(sketch_table_dtype="bfloat16", telemetry_level=1,
+               availability="bernoulli", dropout_prob=0.25,
+               control_policy="budget_pacing", budget_mb=500.0)
+    sess = FederatedSession(cfg, params, loss_fn)
+    ctrl = build_controller(cfg, sess, num_rounds=10)
+    live, avail = 6, 8
+    comp = sess.compressor
+    want_up = comp.upload_bytes_per_float() * comp.masked_upload_floats(live)
+    assert comp.upload_bytes_per_float() == 2
+    bpr = sess.bytes_per_round()
+    assert ctrl.round_bytes(0, live, avail) == (
+        want_up + avail * bpr["download_bytes"]
+    )
+    ctrl._spend(0, live, avail)
+    assert ctrl.spent_up == want_up
+
+
+def test_bf16_sharded_decode_matches_dense_decode_bf16():
+    """The sharded decode under bf16 tables agrees with the DENSE decode
+    under the same bf16 tables (both pay identical storage rounding at
+    the state boundaries; the decode algebra itself runs f32 in both) —
+    the PR-6 parity claim carried over to the new dtype."""
+    ds, params, loss_fn = _setup(12)
+
+    def run(decode):
+        cfg = _cfg(sketch_table_dtype="bfloat16", sketch_decode=decode)
+        sess = FederatedSession(cfg, params, loss_fn)
+        sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                             local_batch_size=cfg.local_batch_size, seed=1)
+        for r in range(3):
+            ids, batch = sampler.sample_round(r)
+            sess.train_round(ids, batch, 0.2)
+        return np.asarray(sess.state.params_vec)
+
+    p_dense = run("dense")
+    p_shard = run("sharded")
+    scale = max(np.abs(p_dense).max(), 1.0)
+    # the two decodes round differently only where bf16 boundaries meet
+    # k-sparse extraction ties; the algebra itself is the pinned PR-6
+    # equivalence — atol scaled like the f32 test's 1e-6 plus bf16 slack
+    np.testing.assert_allclose(p_shard, p_dense, rtol=0, atol=5e-3 * scale)

@@ -339,6 +339,13 @@ def test_v5_pipeline_scalars_validate_and_reject(tmp_path):
           "t": 1.0}, "integer"),
         ({"name": "pipeline/host_stall_ms", "value": "nan", "step": 0,
           "t": 1.0}, "finite number"),
+        # scan engine (sketch-gap PR): the block length is a count of
+        # whole scanned rounds, >= 1 — fractional/zero values mean the
+        # engine miscounted its block plan
+        ({"name": "pipeline/scan_rounds_per_dispatch", "value": 2.5,
+          "step": 0, "t": 1.0}, "positive integer"),
+        ({"name": "pipeline/scan_rounds_per_dispatch", "value": 0.0,
+          "step": 0, "t": 1.0}, "positive integer"),
     ]:
         bad = tmp_path / "bad.jsonl"
         bad.write_text(lines[0] + "\n" + json.dumps(bad_rec) + "\n")
