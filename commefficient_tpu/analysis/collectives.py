@@ -24,7 +24,19 @@ Flagged:
   * a string literal passed as an ``axis_name=`` keyword to ANY call —
     the kwarg name is distinctive enough that ``partial(ring_attention,
     axis_name="seq")`` and ``server_update_sharded(..., axis_name=...)``
-    are covered without enumerating every wrapper.
+    are covered without enumerating every wrapper;
+  * an integer literal in a source/destination slot of a ``ppermute``
+    ``perm=`` table. A perm entry is a (source, destination) DEVICE
+    ID, valid only for one hardcoded mesh size — ``perm=[(0, 1),
+    (1, 0)]`` silently drops chips the moment the workers axis grows
+    past two. Perm tables must be built from the declared axis size
+    (the ``axis_size`` parameter / ``mesh.shape[axis]``), the way
+    ``ops/collectives/sparse_allreduce.py`` derives its
+    recursive-halving schedule (``[(i, i ^ bit) for i in
+    range(n_dev)]``) or ``parallel/tensor.py`` its ring shift
+    (``[(i, (i - 1) % seq_size) ...]``) — entries COMPUTED from a size
+    variable contain no literal in the id slot and stay legal, even
+    when the arithmetic uses constants like the ring's ``- 1``.
 
 Declaring the constant itself (``WORKERS = "workers"`` in
 ``parallel/mesh.py``) is an assignment, not a call, and stays legal —
@@ -77,6 +89,34 @@ def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
     return None
 
 
+def _perm_arg(call: ast.Call) -> Optional[ast.AST]:
+    """``ppermute``'s perm table: the ``perm=`` kwarg or the third
+    positional (``ppermute(x, axis_name, perm)``)."""
+    for kw in call.keywords:
+        if kw.arg == "perm":
+            return kw.value
+    if len(call.args) > 2:
+        return call.args[2]
+    return None
+
+
+def _perm_int_literals(expr: ast.AST):
+    """Integer literals in the id slots of a perm table: direct elements
+    of any tuple/list under the perm expression (``(0, 1)`` is a baked
+    device id; ``(i, (i - 1) % n)`` computes its ids from a size
+    variable — the shift constant lives inside a BinOp, not an id slot,
+    and is legal). Booleans are Constant ints in the ast; they can't be
+    device ids from a hardcoded table, so they're skipped."""
+    for node in ast.walk(expr):
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            continue
+        for el in node.elts:
+            if (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                    and not isinstance(el.value, bool)):
+                yield el
+
+
 def analyze(index: PackageIndex) -> List[Finding]:
     findings: List[Finding] = []
     for sf in index.trees():
@@ -92,6 +132,19 @@ def analyze(index: PackageIndex) -> List[Finding]:
                     if kw.arg == "axis_name":
                         checked = kw.value
                         break
+            if name == "ppermute":
+                perm = _perm_arg(node)
+                if perm is not None:
+                    for lit in _perm_int_literals(perm):
+                        findings.append(sf.finding(
+                            RULE, lit.lineno,
+                            f"integer literal {lit.value!r} in a ppermute "
+                            "perm table — perm entries are device ids, "
+                            "valid only for one hardcoded mesh size; "
+                            "build the table from the declared axis size "
+                            "(e.g. [(i, i ^ bit) for i in "
+                            "range(axis_size)])",
+                        ))
             if checked is None:
                 continue
             for lit in _literal_axes(checked):

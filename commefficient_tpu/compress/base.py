@@ -59,6 +59,20 @@ class Compressor:
     # grad concat is never traced. Only meaningful on the fused
     # flattened-batch path (one gradient per device).
     supports_fused_backward: bool = False
+    # True -> this mode's on-mesh aggregation can ride the sparse
+    # allreduce pair exchange (ops/collectives): its transmit (or server
+    # candidate set) is <= O(W*k)-sparse. Gated by cfg.aggregate through
+    # use_sparse_aggregate() below.
+    supports_sparse_aggregate: bool = False
+    # True -> aggregate='auto' MAY resolve to sparse on a multi-device
+    # mesh (only safe when sparse changes neither stored state shapes nor
+    # the server summation order — local_topk's replicated dense rebuild)
+    sparse_aggregate_in_auto: bool = False
+    # True -> under sparse aggregation the server momentum/error leaves
+    # live SHARDED over the workers axis as [padded_dim(d, Wd)] arrays
+    # (true_topk: reduce-scatter aggregate + sharded select); the session
+    # commits/prewarms those leaves with P(WORKERS) placement
+    sparse_aggregate_shards_state: bool = False
     # True -> the applied delta is dense, so do_topk_down's downlink top-k
     # is meaningful (sketch/true_topk deltas already have <= k nonzeros;
     # powersgd's delta is rank-r factored)
@@ -202,6 +216,45 @@ class Compressor:
         if decode == "sharded":
             return True
         return mesh_workers > 1 and self.cfg.topk_method == "threshold"
+
+    # ---- sparse on-mesh aggregation (replicated engine) ------------------
+    def use_sparse_aggregate(self, mesh_workers: int) -> bool:
+        """Resolve ``cfg.aggregate`` for this mode on a replicated mesh
+        whose ``workers`` axis has ``mesh_workers`` devices.
+
+        ``dense`` / modes without the capability -> False (the legacy
+        full-[D] psum). ``sparse`` -> True (Config already validated the
+        mode/topk/fsdp combination). ``auto`` -> sparse exactly when the
+        pair exchange can win AND cannot change results beyond f32
+        summation order: >1 worker device (a 1-device mesh has no
+        exchange to shrink — and the single-device golden recordings stay
+        bit-untouched), the threshold top-k kernel (the family whose
+        selections the sparse paths are built on), and a mode that opts
+        into auto (``sparse_aggregate_in_auto`` — local_topk only, whose
+        sparse path keeps state shapes and server algebra identical)."""
+        if not self.supports_sparse_aggregate:
+            return False
+        agg = getattr(self.cfg, "aggregate", "auto")
+        if agg == "dense":
+            return False
+        if agg == "sparse":
+            return True
+        return (self.sparse_aggregate_in_auto and mesh_workers > 1
+                and self.cfg.topk_method == "threshold")
+
+    def server_update_sparse(self, momentum, error, extra, agg_sh, lr,
+                             step, *, axis_name, Wd, d):
+        """Sparse-aggregate server update, called INSIDE a shard_map over
+        the ``workers`` axis with SHARDED server state: ``momentum`` /
+        ``error`` / ``agg_sh`` are this chip's [S] = [padded_dim(d,Wd)/Wd]
+        slices (``agg_sh`` from the reduce-scattered transmit sum).
+        Returns ``(idx [Wd*kb], val [Wd*kb], new_momentum_sh,
+        new_error_sh, new_extra)`` — idx/val are REPLICATED (post-gather)
+        global candidate pair buffers with val==0 padding, and the round
+        applies ``params.at[idx].add(-val)`` exactly like the sharded
+        sketch decode. Only classes with ``sparse_aggregate_shards_state``
+        implement it."""
+        raise NotImplementedError
 
     def server_update_sharded(self, momentum, error, extra, agg, lr, step,
                               *, axis_name, Wd, d):

@@ -29,6 +29,12 @@ class LocalTopkCompressor(_DenseServerMixin, Compressor):
     supports_fsdp = False  # per-client [num_clients, D] state: the memory
     # wall is offload_client_state's, not FSDP's
     supports_fused_clients = False  # per-client error/selection by definition
+    # the device's summed transmit has <= w_loc*k nonzeros (each client
+    # sends <= k), so the aggregate rebuilds EXACTLY from one W*k-pair
+    # all_gather — replicated dense result, server algebra untouched, safe
+    # for aggregate='auto' on multi-device meshes
+    supports_sparse_aggregate = True
+    sparse_aggregate_in_auto = True
     dense_delta = True
     # reference behavior: mask local momentum at transmitted coords (applies
     # only with local_momentum > 0; no contrary evidence — r4 four-corner)

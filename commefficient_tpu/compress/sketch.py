@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from commefficient_tpu.compress.base import KIND_NONE, KIND_TABLE, Compressor
 from commefficient_tpu.compress.registry import register
+from commefficient_tpu.ops.collectives import all_gather_pairs
 from commefficient_tpu.ops.countsketch import (
     estimate_at,
     sketch_sparse,
@@ -31,6 +32,13 @@ class SketchCompressor(Compressor):
     supports_fused_clients = True
     supports_sharded_decode = True  # server_update_sharded below
     supports_fused_backward = True  # encode_grad_table below
+    # aggregate='sparse': the [r, c] table psum stays (it is already
+    # O(r*c) << O(D)), but the zero-HH EF re-sketch psums ride the
+    # sparse-allreduce pair exchange instead — gather the <= Wd*k
+    # (idx, val) pairs and re-sketch them locally (linearity: the sketch
+    # of all pairs IS the sum of the per-shard slice sketches). Changes
+    # the f32 summation order, so 'auto' never picks it (explicit only).
+    supports_sparse_aggregate = True
     dense_delta = False  # the unsketched delta already has <= k nonzeros
 
     # ---- bf16 table discipline ------------------------------------------
@@ -56,6 +64,14 @@ class SketchCompressor(Compressor):
         the f32 default (NamedTuple value equality keeps every lru-cached
         geometry hit)."""
         return self.spec._replace(table_dtype=jnp.float32)
+
+    @property
+    def _ride_pair_exchange(self) -> bool:
+        """True when the zero-HH EF re-sketch psums ride the sparse
+        pair exchange (explicit aggregate='sparse' only; Config already
+        validated threshold + sharded decode). The FSDP round never rides
+        — Config rejects aggregate='sparse' under fsdp."""
+        return getattr(self.cfg, "aggregate", "auto") == "sparse"
 
     def _dampening_warnings(self, dampen: bool) -> None:
         if dampen:
@@ -178,10 +194,15 @@ class SketchCompressor(Compressor):
                 upd_val != 0,
                 self._shard_estimate_at()(spec, m, hh_gidx), 0.0,
             )
-            m = m - jax.lax.psum(
-                sketch_sparse(spec, hh_gidx, m_at_hh).astype(spec.table_dtype),
-                axis_name,
-            )
+            if self._ride_pair_exchange:
+                g_i, g_v = all_gather_pairs(hh_gidx, m_at_hh, axis_name)
+                m = m - sketch_sparse(spec, g_i, g_v).astype(spec.table_dtype)
+            else:
+                m = m - jax.lax.psum(
+                    sketch_sparse(spec, hh_gidx,
+                                  m_at_hh).astype(spec.table_dtype),
+                    axis_name,
+                )
         new_m = m if rho > 0 else momentum
         # compact this shard's <= k selected entries into a fixed-size
         # candidate buffer and exchange ~Wd*kb pairs — the ONLY vector
@@ -234,10 +255,18 @@ class SketchCompressor(Compressor):
             # bytes under bf16 tables — and what keeps the xla_audit
             # ledger-vs-HLO tolerance arithmetic exact); the subtraction
             # promotes back to e's f32
-            e = e - jax.lax.psum(
-                sketch_sparse(spec, idx_c[loc], val).astype(spec.table_dtype),
-                axis_name,
-            )
+            if self._ride_pair_exchange:
+                # aggregate='sparse': the table psum becomes a <= Wd*k
+                # pair all_gather + ONE local re-sketch of all pairs
+                # (linearity — same table up to f32 summation order)
+                g_i, g_v = all_gather_pairs(idx_c[loc], val, axis_name)
+                e = e - sketch_sparse(spec, g_i, g_v).astype(spec.table_dtype)
+            else:
+                e = e - jax.lax.psum(
+                    sketch_sparse(spec, idx_c[loc],
+                                  val).astype(spec.table_dtype),
+                    axis_name,
+                )
             if cfg.error_decay != 1.0:
                 e = cfg.error_decay * e
             return upd, upd, e

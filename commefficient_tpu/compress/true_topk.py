@@ -14,7 +14,8 @@ import jax.numpy as jnp
 
 from commefficient_tpu.compress.base import KIND_DENSE, KIND_NONE, Compressor
 from commefficient_tpu.compress.registry import register
-from commefficient_tpu.ops.topk import topk_threshold_sharded
+from commefficient_tpu.ops.collectives import all_gather_pairs
+from commefficient_tpu.ops.topk import compact_nonzero, topk_threshold_sharded
 
 
 @register("true_topk")
@@ -22,6 +23,12 @@ class TrueTopkCompressor(Compressor):
     allowed_error_types = ("none", "virtual")
     supports_fsdp = True
     supports_fused_clients = True
+    # aggregate='sparse': reduce-scatter the dense transmit, run the FSDP
+    # slice algebra on workers-sharded momentum/error, exchange only the
+    # <= W*k selected (idx, val) candidate pairs. Re-homes server state
+    # onto the mesh, so 'auto' never picks it (explicit opt-in only).
+    supports_sparse_aggregate = True
+    sparse_aggregate_shards_state = True
     dense_delta = False  # delta already has <= k nonzeros; skip do_topk_down
 
     def _dampening_warnings(self, dampen: bool) -> None:
@@ -70,17 +77,13 @@ class TrueTopkCompressor(Compressor):
             m = jnp.where(update != 0, 0.0, m)
         return delta, m, e, extra
 
-    def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
-                    d, dp, S):
+    def _sharded_algebra(self, m_in, e_in, agg_sh, lr, *, axis_name):
+        """The per-slice server algebra shared by the FSDP round and the
+        sparse-aggregate replicated round: momentum + lr-scaled virtual
+        error feedback + sharded-threshold selection, all on this chip's
+        [S] coordinate slice. Returns ``(delta_sh, new_m_sh, new_e_sh)``."""
         cfg = self.cfg
         dampen = self.resolved_dampening(warn=False)
-        agg_sh = (
-            jax.lax.psum_scatter(
-                jnp.pad(local, (0, dp - d)), axis_name,
-                scatter_dimension=0, tiled=True,
-            )
-            / W
-        )
         m = cfg.virtual_momentum * m_in + agg_sh
         if cfg.error_type == "virtual":
             e = e_in + lr * m
@@ -98,4 +101,31 @@ class TrueTopkCompressor(Compressor):
             delta_sh = lr * upd
         if dampen:
             m = jnp.where(upd != 0, 0.0, m)
+        return delta_sh, m, e
+
+    def fsdp_update(self, p_sh, m_in, e_in, local, lr, *, axis_name, W,
+                    d, dp, S):
+        agg_sh = (
+            jax.lax.psum_scatter(
+                jnp.pad(local, (0, dp - d)), axis_name,
+                scatter_dimension=0, tiled=True,
+            )
+            / W
+        )
+        delta_sh, m, e = self._sharded_algebra(m_in, e_in, agg_sh, lr,
+                                               axis_name=axis_name)
         return p_sh - delta_sh, m, e
+
+    def server_update_sparse(self, momentum, error, extra, agg_sh, lr,
+                             step, *, axis_name, Wd, d):
+        delta_sh, m, e = self._sharded_algebra(momentum, error, agg_sh, lr,
+                                               axis_name=axis_name)
+        # each shard owns a disjoint balanced index range, so its <= k
+        # selected coordinates never collide with another shard's; one
+        # Wd*k pair all_gather replaces the dense [D] exchange
+        S = agg_sh.shape[0]
+        my = jax.lax.axis_index(axis_name)
+        loc, val = compact_nonzero(delta_sh, self.cfg.k)
+        gidx = jnp.minimum(my * S + loc, d - 1)  # clip padding coords
+        g_idx, g_val = all_gather_pairs(gidx, val, axis_name)
+        return g_idx, g_val, m, e, extra

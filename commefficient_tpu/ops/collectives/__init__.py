@@ -1,0 +1,22 @@
+"""Sparse collective primitives — (idx, val) pair exchange over the mesh.
+
+See ``sparse_allreduce`` for the design notes (gather form vs the
+recursive-halving ``ppermute`` form, and which ``shard_map`` out_specs
+each is legal under).
+"""
+
+from commefficient_tpu.ops.collectives.sparse_allreduce import (
+    all_gather_pairs,
+    compact_pairs,
+    scatter_add_pairs,
+    sparse_allreduce,
+    sparse_allreduce_sharded,
+)
+
+__all__ = [
+    "all_gather_pairs",
+    "compact_pairs",
+    "scatter_add_pairs",
+    "sparse_allreduce",
+    "sparse_allreduce_sharded",
+]

@@ -66,17 +66,27 @@ class _Rung:
     index path is active."""
 
     __slots__ = ("cfg", "label", "spec", "compressor", "round_fn",
-                 "sketch_decode_resolved", "round_idx_fn")
+                 "sketch_decode_resolved", "aggregate_resolved",
+                 "round_idx_fn")
 
     def __init__(self, cfg, label, spec, compressor, round_fn,
-                 sketch_decode_resolved):
+                 sketch_decode_resolved, aggregate_resolved):
         self.cfg = cfg
         self.label = label  # "" (single rung) | "rung0", "rung1", ...
         self.spec = spec
         self.compressor = compressor
         self.round_fn = round_fn
         self.sketch_decode_resolved = sketch_decode_resolved
+        self.aggregate_resolved = aggregate_resolved  # "sparse" | "dense"
         self.round_idx_fn = None
+
+    @property
+    def sparse_state(self) -> bool:
+        """True when this rung's server momentum/error leaves live
+        SHARDED over the workers axis (true_topk sparse aggregation) —
+        drives the state commit/prewarm placement in the session."""
+        return (self.aggregate_resolved == "sparse"
+                and self.compressor.sparse_aggregate_shards_state)
 
     @property
     def idx_hook_name(self) -> str:
@@ -201,6 +211,7 @@ class FederatedSession:
         # construct their own trace-time instances from the same registry.
         self.compressor = rung.compressor
         self.sketch_decode_resolved = rung.sketch_decode_resolved
+        self.aggregate_resolved = rung.aggregate_resolved
         self.round_fn = rung.round_fn
         if cfg.fsdp:
             # FSDP round (parallel/fsdp.py): params + dense server state
@@ -246,6 +257,16 @@ class FederatedSession:
                 else a,
                 self.state,
             )
+            if rung.sparse_state:
+                # true_topk sparse aggregation: momentum/error live as
+                # [padded_dim] vectors SHARDED over the workers axis (the
+                # decode shard_map consumes each chip's slice in place —
+                # an O(D) replicated copy per chip is exactly what the
+                # sparse path removes)
+                self.state = self.state._replace(
+                    momentum=self._shard_server_leaf(self.state.momentum),
+                    error=self._shard_server_leaf(self.state.error),
+                )
 
     # -- rung build / switch (control/ compression ladder) -----------------
     def _build_rung(self, rcfg: Config, label: str) -> _Rung:
@@ -349,6 +370,31 @@ class FederatedSession:
             if not rcfg.fsdp and compressor.use_sharded_decode(_ws)
             else "dense"
         )
+        # on-mesh aggregation resolution (cfg.aggregate; same call the
+        # round builder makes) — surfaced so bench/audit/tests can report
+        # which aggregation a session compiled without re-deriving the
+        # auto rule. Moot under FSDP (its reduce-scatter already moves
+        # O(D/W) per chip; Config rejects an explicit 'sparse' there).
+        aggregate_resolved = (
+            "sparse"
+            if not rcfg.fsdp and compressor.use_sparse_aggregate(_ws)
+            else "dense"
+        )
+        if (
+            rcfg.aggregate == "sparse"
+            and not rcfg.fsdp
+            and _ws == 1
+            and label in ("", "rung0")  # once per session (first rung)
+        ):
+            import warnings
+
+            warnings.warn(
+                "aggregate='sparse' on a 1-device workers mesh is the "
+                "degenerate case: there is no cross-chip exchange to "
+                "shrink, so the pair compaction/scatter is pure overhead "
+                "on top of a psum XLA already elides. 'auto' picks dense "
+                "here for exactly that reason."
+            )
         if (
             rcfg.sketch_decode == "sharded"
             and not rcfg.fsdp
@@ -379,7 +425,8 @@ class FederatedSession:
                 rcfg, self._loss_fn, self.unravel, self.mesh, spec,
                 d=self.grad_size, trace_hook=hook,
             )
-        return _Rung(rcfg, label, spec, compressor, round_fn, decode_resolved)
+        return _Rung(rcfg, label, spec, compressor, round_fn,
+                     decode_resolved, aggregate_resolved)
 
     def set_active_rung(self, i: int, *, migrate: bool = True) -> None:
         """Switch dispatch to rung ``i``: swap the session's active
@@ -408,6 +455,7 @@ class FederatedSession:
         self.spec = new.spec
         self.compressor = new.compressor
         self.sketch_decode_resolved = new.sketch_decode_resolved
+        self.aggregate_resolved = new.aggregate_resolved
         self.round_fn = new.round_fn
         if self._dev_data is not None:
             self._round_idx_fn = new.round_idx_fn
@@ -422,6 +470,11 @@ class FederatedSession:
 
             sh = fsdp_state_shardings(rung.cfg, self.mesh)
             shardings = (sh.momentum, sh.error, self._replicated)
+        elif rung.sparse_state:
+            # workers-sharded [padded_dim] momentum/error (commit pads a
+            # [D] leaf arriving from a dense-layout rung)
+            shardings = (self._batch_sharding, self._batch_sharding,
+                         self._replicated)
         else:
             shardings = (self._replicated,) * 3
 
@@ -429,12 +482,33 @@ class FederatedSession:
             if isinstance(leaf, tuple) or leaf is old_leaf:
                 return leaf
             s = sharding if not isinstance(sharding, tuple) else self._replicated
-            return jax.device_put(jnp.asarray(leaf), s)
+            leaf = jnp.asarray(leaf)
+            if (s is self._batch_sharding and leaf.ndim == 1
+                    and leaf.shape[0] == self.grad_size):
+                dp = self._padded_grad_size()
+                leaf = jnp.pad(leaf, (0, dp - self.grad_size))
+            return jax.device_put(leaf, s)
 
         return tuple(
             commit(leaf, sh_, o)
             for leaf, sh_, o in zip((m, e, x), shardings, old)
         )
+
+    def _padded_grad_size(self) -> int:
+        """grad_size rounded up to a workers-axis multiple — the length of
+        workers-sharded [padded_dim] server-state vectors."""
+        from commefficient_tpu.parallel.fsdp import padded_dim
+
+        return padded_dim(self.grad_size, self._n_mesh_devices)
+
+    def _shard_server_leaf(self, leaf):
+        """Pad a dense [D] server leaf to [padded_dim] and commit it
+        sharded over the workers axis (true_topk sparse aggregation)."""
+        if not isinstance(leaf, jnp.ndarray) or leaf.ndim != 1:
+            return leaf
+        if leaf.shape[0] == self.grad_size:
+            leaf = jnp.pad(leaf, (0, self._padded_grad_size() - self.grad_size))
+        return jax.device_put(leaf, self._batch_sharding)
 
     def rung_bytes_per_round(self, i: int) -> Dict[str, int]:
         """``bytes_per_round`` for rung ``i`` (the controller's and the
@@ -484,6 +558,19 @@ class FederatedSession:
                     return jax.ShapeDtypeStruct(
                         rung.spec.table_shape, rung.spec.table_dtype
                     )
+                return ()
+
+            m, e, x = shape(m_kind), shape(e_kind), ()
+        elif rung.sparse_state:
+            # workers-sharded server state: dense [D] kinds become
+            # [padded_dim] (same geometry as the FSDP branch above, but
+            # only for momentum/error — params stay replicated)
+            dp = self._padded_grad_size()
+            m_kind, e_kind = rung.compressor.server_state_kinds()
+
+            def shape(kind):
+                if kind == KIND_DENSE:
+                    return jax.ShapeDtypeStruct((dp,), jnp.float32)
                 return ()
 
             m, e, x = shape(m_kind), shape(e_kind), ()
@@ -1016,15 +1103,51 @@ class FederatedSession:
         up = self.bytes_per_round()["upload_bytes"]
         # k from the ACTIVE rung's config (the program being audited)
         k_active = self.rungs[self.active_rung].cfg.k
+        has_sparse_agg = (
+            not self.cfg.fsdp and self.compressor.supports_sparse_aggregate
+        )
+        aggregate = self.aggregate_resolved if has_sparse_agg else None
+        sparse_agg_bound = None
+        if aggregate == "sparse":
+            # the largest LEGAL all-reduce/all-gather on the sparse path:
+            # the pair exchange. local_topk gathers each chip's w_loc*k
+            # candidate buffer; true_topk gathers k per shard; sketch keeps
+            # its O(r*c) table psum (the mode's design payload) and rides
+            # only the EF re-sketch on the pair exchange.
+            sparse_agg_bound = W * k_active
+            if self.compressor.needs_sketch_spec:
+                spec = self.rungs[self.active_rung].spec
+                table_elems = 1
+                for dim in spec.table_shape:
+                    table_elems *= int(dim)
+                sparse_agg_bound = max(sparse_agg_bound, table_elems)
+            elif not self.compressor.sparse_aggregate_shards_state:
+                w_loc = max(1, cids.shape[0] // W)
+                sparse_agg_bound = W * w_loc * k_active
+            active_cfg = self.rungs[self.active_rung].cfg
+            if not active_cfg.offload_client_state and (
+                needs_client_vel(active_cfg) or needs_client_err(active_cfg)
+            ):
+                # in-graph per-client rows predate sparse aggregation: the
+                # scatter-back into the replicated [num_clients, D] state
+                # all-gathers the w participating rows (w*D elems). It is
+                # state residency, not aggregation traffic — offload the
+                # client state (the large-model config) and the strict
+                # O(W*k) bound holds with no exemption.
+                sparse_agg_bound = max(
+                    sparse_agg_bound, cids.shape[0] * self.grad_size
+                )
         return CompiledRoundAudit.from_compiled(
             compiled,
             engine="fsdp" if self.cfg.fsdp else "replicated",
             mode=self.cfg.mode,
             sketch_decode=self.sketch_decode_resolved if is_sketch else None,
+            aggregate=aggregate,
             grad_size=self.grad_size,
             workers_mesh=W,
             ledger_up_bytes=up,
             wk_bound=W * k_active if sharded else None,
+            sparse_agg_bound=sparse_agg_bound,
             tolerance_bytes=ledger_tolerance(
                 up, sharded=sharded, workers=W, k=k_active
             ),

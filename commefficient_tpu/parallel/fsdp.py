@@ -81,6 +81,7 @@ from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.parallel.mesh import WORKERS
 from commefficient_tpu.parallel.round import (
     FedState,
+    _psum_fused,
     make_grad_one,
     sum_client_grads,
 )
@@ -257,8 +258,13 @@ def build_fsdp_round_fn(
             grad_one, params_vec, batch, client_ids, rng, fused=fused,
             live=live_sh, corrupt=corr_sh,
         )
-        loss_mean = jax.lax.psum(loss_local, WORKERS) / W
-        aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)
+        # one fused all-reduce for the scalar telemetry (loss + aux leaves)
+        # instead of one per leaf — the gradient payload itself stays in
+        # fsdp_update's psum_scatter
+        aux_leaves, aux_def = jax.tree.flatten(aux)
+        summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        loss_mean = summed[0] / W
+        aux_sum = jax.tree.unflatten(aux_def, summed[1:])
         if use_fedsim:
             # renormalize by the live count BEFORE fsdp_update (whose
             # internal psum/psum_scatter averages by W): scaling the

@@ -69,7 +69,9 @@ import jax.numpy as jnp
 from jax.flatten_util import ravel_pytree
 
 from commefficient_tpu.compress import get_compressor
+from commefficient_tpu.compress.base import KIND_DENSE
 from commefficient_tpu.models.losses import IGNORE_INDEX
+from commefficient_tpu.ops.collectives import sparse_allreduce
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
 from commefficient_tpu.parallel.mesh import WORKERS
@@ -106,6 +108,37 @@ def needs_client_vel(cfg: Config) -> bool:
 
 def needs_client_err(cfg: Config) -> bool:
     return cfg.error_type == "local"
+
+
+def _psum_fused(leaves, axis_name):
+    """ONE all-reduce for the round's same-dtype reductions.
+
+    The psum of a concatenation of raveled f32 leaves equals the
+    concatenation of the per-leaf psums ELEMENTWISE (an all-reduce adds
+    slot-by-slot in a fixed order), so fusing agg/loss/aux into a single
+    collective changes no value — only the launch count (the golden
+    parity recordings stay bit-identical; the all-reduce op count is
+    HLO-pinned by tests/test_sparse_aggregate.py). Non-f32 leaves (the
+    bf16 sketch table) keep their own psum: mixing dtypes in one payload
+    would force a cast. Returns the summed leaves in input order,
+    UN-divided (callers own the /W)."""
+    leaves = list(leaves)
+    out = list(leaves)
+    f32_ix = [i for i, a in enumerate(leaves) if a.dtype == jnp.float32]
+    if len(f32_ix) >= 2:
+        flat = jnp.concatenate([leaves[i].ravel() for i in f32_ix])
+        summed = jax.lax.psum(flat, axis_name)
+        off = 0
+        for i in f32_ix:
+            n = leaves[i].size
+            out[i] = summed[off:off + n].reshape(leaves[i].shape)
+            off += n
+        rest = [i for i in range(len(leaves)) if i not in f32_ix]
+    else:
+        rest = list(range(len(leaves)))
+    for i in rest:
+        out[i] = jax.lax.psum(leaves[i], axis_name)
+    return out
 
 
 def init_state(cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch]) -> FedState:
@@ -379,6 +412,25 @@ def build_round_fn(
         else None
     )
 
+    # ---- on-mesh aggregation strategy (cfg.aggregate; ops/collectives):
+    # resolved at trace time from the compressor capability + the mesh —
+    # a python-level gate like telemetry_level/fedsim, so the dense
+    # round's trace is untouched when off. sparse_gather (local_topk):
+    # the replicated dense aggregate rebuilds from one W*k-pair
+    # all_gather + scatter-add; everything downstream (server algebra,
+    # fedsim scale, dampening, offload) is unchanged. sparse_state
+    # (true_topk): the dense transmit reduce-scatters to [S] slices, the
+    # server momentum/error live SHARDED over the workers axis, and the
+    # decode shard_map below runs the FSDP slice algebra — the only
+    # vector exchange is the <= W*k candidate pair all_gather. The sketch
+    # EF re-sketch ride lives inside the compressor (compress/sketch.py
+    # _ride_pair_exchange); its table psum is already O(r*c), not O(D).
+    Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
+    use_sparse_agg = comp.use_sparse_aggregate(Wd)
+    sparse_state = use_sparse_agg and comp.sparse_aggregate_shards_state
+    sparse_gather = (use_sparse_agg and not sparse_state
+                     and not comp.needs_sketch_spec)
+
     # ---- the shard body: this IS the worker process ----------------------
     def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng,
                      lr, *fs):
@@ -467,9 +519,40 @@ def build_round_fn(
             aux = jax.tree.map(lambda a: jnp.sum(a, 0), aux)
         if not (fused and sketch_fused):  # fused-bwd already encoded above
             local = comp.device_encode(local)  # linear -> psum is exact
-        agg = jax.lax.psum(local, WORKERS) / W
-        loss_mean = jax.lax.psum(loss_local, WORKERS) / W
-        aux_sum = jax.tree.map(lambda a: jax.lax.psum(a, WORKERS), aux)
+        aux_leaves, aux_def = jax.tree.flatten(aux)
+        if sparse_state:
+            # true_topk sparse aggregation: reduce-scatter the dense
+            # transmit sum — each chip keeps only its balanced [S] slice
+            # of the padded [dp] vector (no O(D) all-reduce ever; the
+            # server algebra downstream is sharded to match)
+            dp = Wd * -(-d // Wd)
+            agg = (
+                jax.lax.psum_scatter(
+                    jnp.pad(local, (0, dp - d)), WORKERS,
+                    scatter_dimension=0, tiled=True,
+                )
+                / W
+            )
+            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        elif sparse_gather:
+            # local_topk sparse aggregation: the device's summed transmit
+            # has <= w_loc*k nonzeros (each client sends <= k), so one
+            # W*k-pair all_gather + scatter-add rebuilds the replicated
+            # dense aggregate — equal to the psum up to f32 summation
+            # order, and everything downstream is byte-for-byte the dense
+            # server path
+            with jax.named_scope("sparse_allreduce"):
+                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS) / W
+            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        else:
+            # dense path: ONE fused all-reduce carries agg+loss+aux (the
+            # bf16 sketch table keeps its own psum — see _psum_fused)
+            fused_sum = _psum_fused([local, loss_local] + aux_leaves,
+                                    WORKERS)
+            agg = fused_sum[0] / W
+            summed = fused_sum[1:]
+        loss_mean = summed[0] / W
+        aux_sum = jax.tree.unflatten(aux_def, summed[1:])
         return agg, loss_mean, aux_sum, new_vel, new_err
 
     shard_spec = P(WORKERS)
@@ -480,7 +563,10 @@ def build_round_fn(
         worker_shard,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(), P(), P(), shard_spec, shard_spec),
+        # sparse_state: agg leaves the shard_map as this chip's [S] slice
+        # of the workers-sharded [dp] aggregate, not a replicated [d]
+        out_specs=(shard_spec if sparse_state else P(), P(), P(),
+                   shard_spec, shard_spec),
     )
 
     # ---- sharded server decode (the FSDP decode discipline on replicated
@@ -494,22 +580,36 @@ def build_round_fn(
     # scatter — no [D] estimate, no [D] unsketch transient, no dense
     # re-sketch, no D-sized collective (pinned by the HLO test in
     # tests/test_sketch_decode.py).
-    Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
     sharded_decode = comp.use_sharded_decode(Wd)
+    # both sparse-apply decodes return gathered (idx, val) candidate pair
+    # buffers instead of a dense delta; only the STATE placement differs
+    # (sketch: replicated tables, sharded extraction; true_topk sparse
+    # aggregation: momentum/error themselves sharded over workers)
+    sparse_apply = sharded_decode or sparse_state
     decode_mapped = None
-    if sharded_decode:
+    if sparse_apply:
+        _, e_kind = comp.server_state_kinds()
 
         def decode_shard(momentum, error, comp_state, agg, lr, step):
+            if sparse_state:
+                return comp.server_update_sparse(
+                    momentum, error, comp_state, agg, lr, step,
+                    axis_name=WORKERS, Wd=Wd, d=d,
+                )
             return comp.server_update_sharded(
                 momentum, error, comp_state, agg, lr, step,
                 axis_name=WORKERS, Wd=Wd, d=d,
             )
 
+        st_spec = P(WORKERS) if sparse_state else P()
+        e_spec = (
+            P(WORKERS) if sparse_state and e_kind == KIND_DENSE else P()
+        )
         decode_mapped = shard_map(
             decode_shard,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), P()),
-            out_specs=(P(), P(), P(), P(), P()),
+            in_specs=(st_spec, e_spec, P(), st_spec, P(), P()),
+            out_specs=(P(), P(), st_spec, e_spec, P()),
         )
 
     def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(),
@@ -565,15 +665,19 @@ def build_round_fn(
         # decode paths; the fedsim all-dropped guard, the state merges,
         # and the metrics/telemetry assembly below are shared so their
         # semantics cannot drift between decodes.
-        if sharded_decode:
-            # sharded decode: each chip extracts its D/W slice inside the
+        if sparse_apply:
+            # sparse apply: each chip extracts its D/W slice inside the
             # shard_map; the replicated outputs are the gathered ~Wd*k
             # (idx, val) candidate buffers (val==0 padding) + the updated
-            # (replicated) server-state leaves. The update applies as a
-            # k-sparse scatter — the dense [D] delta never exists.
-            # (do_topk_down is moot here: every sharded-decode mode has
-            # dense_delta=False — the candidates are already <= k pairs.)
-            with jax.named_scope("sketch_decode_sharded"):
+            # server-state leaves (replicated tables for the sketch
+            # decode; workers-sharded [dp] vectors under true_topk sparse
+            # aggregation). The update applies as a k-sparse scatter —
+            # the dense [D] delta never exists. (do_topk_down is moot
+            # here: every sparse-apply mode has dense_delta=False — the
+            # candidates are already <= k pairs.)
+            scope = ("sketch_decode_sharded" if sharded_decode
+                     else "sparse_aggregate_decode")
+            with jax.named_scope(scope):
                 g_idx, g_val, new_m, new_e, new_comp = decode_mapped(
                     state.momentum, state.error, state.comp, agg, lr,
                     state.step,
@@ -613,7 +717,7 @@ def build_round_fn(
                 return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
                                     new, old)
 
-            if sharded_decode:
+            if sparse_apply:
                 g_val = jnp.where(ok, g_val, 0.0)
             else:
                 delta = jnp.where(ok, delta, 0.0)
@@ -622,7 +726,7 @@ def build_round_fn(
             new_comp = keep(new_comp, state.comp)
         new_params = (
             state.params_vec.at[g_idx].add(-g_val)
-            if sharded_decode
+            if sparse_apply
             else state.params_vec - delta
         )
         metrics = {"loss": loss, **aux}
@@ -641,7 +745,7 @@ def build_round_fn(
                     round_diagnostics_sparse(
                         cfg, comp, idx=g_idx, val=g_val, **common
                     )
-                    if sharded_decode
+                    if sparse_apply
                     else round_diagnostics(
                         cfg, comp, delta=delta,
                         client_err_rows=(

@@ -112,7 +112,15 @@ from commefficient_tpu.telemetry.xla_audit import (
 # first bad round, rollback target, outcome), the "_recovery"-tagged
 # flight dump written after a successful rollback, and the fedsim/preempt
 # scheduled-preemption stat.
-SCHEMA_VERSION = 6
+# v7 (sparse allreduce collective layer PR): perf_report.json gains the
+# resolved "aggregate" path (null | 'dense' | 'sparse') and the
+# collectives block's "sparse_agg_bound" + "max_all_reduce_elems" fields;
+# on aggregate == 'sparse' the checker ENFORCES that no single all-reduce
+# or all-gather moves more elements than sparse_agg_bound (the O(W*k)
+# pair-exchange ceiling — a reduce-scatter of [D] stays legal: it moves
+# O(D/W) per link and lands sharded), mirroring the v3 sharded-decode
+# wk_bound invariant.
+SCHEMA_VERSION = 7
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

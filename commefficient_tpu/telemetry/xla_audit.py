@@ -131,16 +131,21 @@ def collective_audit(hlo_text: str) -> Dict[str, Any]:
     """Walk a compiled module's text for collective ops.
 
     Returns ``{"ops": {op: {"count", "bytes"}}, "total_bytes",
-    "max_all_gather_elems"}`` — bytes are the per-chip RESULT bytes of each
-    collective (variadic/tuple-shaped all-reduces sum their components),
-    counted once per static HLO occurrence; ``max_all_gather_elems`` is the
-    largest single all-gather result (None when the program has none) —
-    the quantity the PR-6 ``<= W*k`` discipline bounds.
+    "max_all_gather_elems", "max_all_reduce_elems"}`` — bytes are the
+    per-chip RESULT bytes of each collective (variadic/tuple-shaped
+    all-reduces sum their components), counted once per static HLO
+    occurrence; ``max_all_gather_elems`` is the largest single all-gather
+    result (None when the program has none) — the quantity the PR-6
+    ``<= W*k`` discipline bounds — and ``max_all_reduce_elems`` its
+    all-reduce twin, which the sparse-aggregate discipline bounds (a
+    reduce-scatter of [D] is ALLOWED there: it moves O(D/W) per link and
+    lands sharded, unlike an all-reduce's replicated [D] result).
     """
     ops: Dict[str, Dict[str, int]] = {
         op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS
     }
     max_ag: Optional[int] = None
+    max_ar: Optional[int] = None
     for line in hlo_text.splitlines():
         m = _COLL_LINE_RE.search(line)
         if m is None:
@@ -165,10 +170,13 @@ def collective_audit(hlo_text: str) -> Dict[str, Any]:
         ops[op]["bytes"] += line_bytes
         if op == "all-gather":
             max_ag = line_elems if max_ag is None else max(max_ag, line_elems)
+        elif op == "all-reduce":
+            max_ar = line_elems if max_ar is None else max(max_ar, line_elems)
     return {
         "ops": {k: v for k, v in ops.items() if v["count"]},
         "total_bytes": sum(v["bytes"] for v in ops.values()),
         "max_all_gather_elems": max_ag,
+        "max_all_reduce_elems": max_ar,
     }
 
 
@@ -257,10 +265,12 @@ class CompiledRoundAudit:
 
     def __init__(self, *, cost: dict, memory: dict, collectives: dict,
                  engine: str = "replicated", mode: str = "",
-                 sketch_decode: Optional[str] = None, grad_size: int = 0,
+                 sketch_decode: Optional[str] = None,
+                 aggregate: Optional[str] = None, grad_size: int = 0,
                  workers_mesh: int = 1,
                  ledger_up_bytes: Optional[int] = None,
                  wk_bound: Optional[int] = None,
+                 sparse_agg_bound: Optional[int] = None,
                  tolerance_bytes: Optional[int] = None,
                  hlo_unavailable_reason: Optional[str] = None):
         self.cost = cost
@@ -268,11 +278,16 @@ class CompiledRoundAudit:
         self.engine = engine
         self.mode = mode
         self.sketch_decode = sketch_decode
+        # resolved --aggregate path (None when the compressor has no sparse
+        # aggregation capability): 'sparse' arms the checker's no-O(D)
+        # all-reduce/all-gather enforcement against sparse_agg_bound
+        self.aggregate = aggregate
         self.grad_size = int(grad_size)
         self.workers_mesh = int(workers_mesh)
         self.hlo_unavailable_reason = hlo_unavailable_reason
         coll = dict(collectives)
         coll["wk_bound"] = wk_bound
+        coll["sparse_agg_bound"] = sparse_agg_bound
         coll["ledger_up_bytes"] = ledger_up_bytes
         if ledger_up_bytes is not None:
             delta = coll["total_bytes"] - int(ledger_up_bytes)
@@ -351,6 +366,7 @@ class CompiledRoundAudit:
             "engine": self.engine,
             "mode": self.mode,
             "sketch_decode": self.sketch_decode,
+            "aggregate": self.aggregate,
             "grad_size": self.grad_size,
             "workers_mesh": self.workers_mesh,
             "cost": self.cost,

@@ -252,6 +252,25 @@ class Config:
     # recordings and CPU tier-1 defaults are bit-untouched. See README
     # "Sketch decode architecture".
     sketch_decode: str = "auto"
+    # On-mesh aggregation strategy for the top-k modes ("auto" | "dense"
+    # | "sparse"). "dense": the legacy full-[D] psum of the per-device
+    # client-transmit sum. "sparse": the ops/collectives pair exchange —
+    # compact the <=k-sparse transmit to (idx, val) buffers and move
+    # O(W*k) pairs instead of O(D) slots (arXiv:2201.07598 style).
+    # local_topk rebuilds the replicated dense aggregate from one
+    # W*k-pair all_gather; true_topk re-homes server momentum/error onto
+    # the workers axis (reduce-scatter aggregate + sharded threshold
+    # select + candidate pair exchange, the FSDP decode discipline on the
+    # replicated round — requires topk_method='threshold'); sketch keeps
+    # its dense [r,c] table psum but rides the pair exchange for the
+    # zero-HH EF re-sketch (sharded decode only). "auto" (default):
+    # sparse exactly when it cannot change stored state shapes — mode
+    # 'local_topk' AND >1 worker device AND topk_method='threshold';
+    # 1-device meshes and every other mode keep the dense psum, so golden
+    # recordings and level-0 HLO are bit-untouched. true_topk/sketch
+    # engage only on an explicit "sparse" (their summation order or state
+    # placement changes). See README "Sparse allreduce collective layer".
+    aggregate: str = "auto"
     # CountSketch kernel backend for the matmul-path ops ("einsum" |
     # "pallas"). "einsum" (default): the banded one-hot einsum +
     # overlap-add — runs everywhere, the r1-r5 production path. "pallas":
@@ -548,6 +567,49 @@ class Config:
                     "fast path), or leave sketch_decode='auto' to keep "
                     f"topk_method={self.topk_method!r} on the dense decode"
                 )
+        if self.aggregate not in ("auto", "dense", "sparse"):
+            raise ValueError(
+                "aggregate must be auto|dense|sparse, "
+                f"got {self.aggregate!r}"
+            )
+        if self.aggregate == "sparse":
+            if self.mode not in ("local_topk", "true_topk", "sketch"):
+                raise ValueError(
+                    "aggregate='sparse' exchanges <=k-sparse (idx, val) "
+                    f"pairs on-mesh; mode={self.mode!r} has no sparse "
+                    "transmit. Leave aggregate='auto' (a no-op there)."
+                )
+            if self.fsdp:
+                raise ValueError(
+                    "aggregate='sparse' targets the replicated round; the "
+                    "FSDP round already reduce-scatters O(D/W) per chip "
+                    "and exchanges only W*k candidate pairs. Leave "
+                    "aggregate='auto' under fsdp=True."
+                )
+            if self.mode == "true_topk" and self.topk_method != "threshold":
+                raise ValueError(
+                    "aggregate='sparse' with mode='true_topk' selects the "
+                    "global top-<=k with the sharded threshold kernel; "
+                    "set topk_method='threshold', or leave "
+                    "aggregate='auto' to keep the dense psum with "
+                    f"topk_method={self.topk_method!r}"
+                )
+            if self.mode == "sketch":
+                if self.topk_method != "threshold":
+                    raise ValueError(
+                        "aggregate='sparse' with mode='sketch' rides the "
+                        "sharded-decode pair exchange for the EF "
+                        "re-sketch; set topk_method='threshold' (the "
+                        "sharded decode's requirement), or leave "
+                        "aggregate='auto'"
+                    )
+                if self.sketch_decode == "dense":
+                    raise ValueError(
+                        "aggregate='sparse' with mode='sketch' requires "
+                        "the sharded server decode (its pair exchange is "
+                        "what the EF re-sketch rides); remove "
+                        "sketch_decode='dense' or leave aggregate='auto'"
+                    )
         if self.synthetic_variant not in (
             "flat", "concentrated", "concentrated_v2"
         ):

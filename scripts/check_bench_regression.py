@@ -77,6 +77,11 @@ TOLERANCES = {
     # informational by having no gated suffix.
     "gpt2_sketch_vs_uncompressed": 0.10,
     "gpt2_sketch_scan_vs_uncompressed": 0.10,
+    # sparse-aggregate PR: the *_sparse_agg_vs_dense twins divide two
+    # measurements of the same run on the same mesh (load cancels) — the
+    # tight ratio band, same reasoning as the gpt2 ratios above
+    "local_topk_sparse_agg_vs_dense": 0.10,
+    "true_topk_sparse_agg_vs_dense": 0.10,
 }
 
 # pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated
@@ -88,7 +93,7 @@ TOLERANCES = {
 LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
 HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
 HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
-                             "_samples_per_sec", "_occupancy")
+                             "_samples_per_sec", "_occupancy", "_vs_dense")
 # resilience/control PRs: every *_retraces leg gauge is a hard invariant,
 # not a throughput — the AOT-prewarm contract says rung switches and
 # rollback restores never retrace, so ANY non-zero value fails outright

@@ -58,8 +58,13 @@ from pathlib import Path
 # (integer counters, preempt_requested in {0, 1}, rollback_round >= -1 —
 # enforced below), the flight dump's recovery_history block (one entry
 # per divergence rollback), and the fedsim/preempt scheduled-preemption
-# stat. Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6)
+# stat; v7 (sparse allreduce collective layer PR): perf_report "aggregate"
+# field + collectives "sparse_agg_bound"/"max_all_reduce_elems" — on
+# aggregate == 'sparse' NO single all-reduce or all-gather may move more
+# elements than sparse_agg_bound (enforced below; reduce-scatter is
+# exempt by design: O(D/W) per link, sharded result). Older artifacts
+# stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
@@ -606,6 +611,28 @@ def validate_perf_report(path) -> dict:
                 f"{coll.get('delta_bytes')} B outside the accounting "
                 f"tolerance {coll.get('tolerance_bytes')} B"
             )
+    # the sparse-aggregate path's O(W*k) on-mesh claim is likewise
+    # enforced (v7, ISSUE 14 acceptance): neither replicating collective
+    # may move a d-sized payload. reduce-scatter is exempt by design —
+    # it moves O(D/W) per link and lands sharded, which is exactly the
+    # layout the sparse decode consumes.
+    if rec.get("aggregate") == "sparse":
+        bound = coll.get("sparse_agg_bound")
+        if not isinstance(bound, int) or bound < 1:
+            raise SchemaError(
+                f"{where}: sparse aggregation requires a positive "
+                "sparse_agg_bound"
+            )
+        for field, opname in (("max_all_gather_elems", "all-gather"),
+                              ("max_all_reduce_elems", "all-reduce")):
+            mx = coll.get(field)
+            if mx is not None and mx > bound:
+                raise SchemaError(
+                    f"{where}: sparse aggregation {opname} of {mx} "
+                    f"elements exceeds the pair-exchange bound ({bound}) "
+                    "— a d-sized replicating collective leaked into the "
+                    "compiled round"
+                )
     return rec
 
 

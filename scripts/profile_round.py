@@ -243,6 +243,37 @@ def main():
         print("[decode fused] pallas slice skipped on non-TPU host "
               "(pass --sketch_backend pallas to force interpret mode)")
 
+    # -- aggregation phase lines (sparse-allreduce PR) ---------------------
+    # single-device stand-ins, same convention as the decode lines above:
+    # the dense line is the W-way [D] reduction every chip's all-reduce
+    # realizes; the sparse line is the pair-exchange realization — compact
+    # each chip's <= k-sparse transmit, then scatter-add all W*k gathered
+    # (idx, val) pairs into the dense aggregate. Cross-chip it moves
+    # O(W*k) elements instead of O(D); on one chip the lines compare the
+    # two realizations' arithmetic.
+    from commefficient_tpu.ops.collectives import scatter_add_pairs
+    from commefficient_tpu.ops.topk import (
+        compact_nonzero as _compact,
+        topk_threshold_dense as _thr_dense,
+    )
+
+    sparse_bufs = jax.jit(jax.vmap(lambda key: _thr_dense(
+        jax.random.normal(key, (d,)), k)))(
+            jax.random.split(jax.random.key(0), W))
+
+    def dense_agg(bufs):
+        return jnp.sum(bufs, axis=0)
+
+    def sparse_agg(bufs):
+        loc, val = jax.vmap(lambda b: _compact(b, k))(bufs)
+        return scatter_add_pairs(d, loc.reshape(-1), val.reshape(-1))
+
+    timeit(f"[aggregate dense] W-way [D] reduction (W={W})",
+           jax.jit(dense_agg), sparse_bufs, reps=r)
+    timeit(f"[aggregate sparse W={W}] compact + {W}x{k // 1000}k-pair "
+           "scatter-add",
+           jax.jit(sparse_agg), sparse_bufs, reps=r)
+
     # -- sketch-fused backward phase line (sketch-gap PR) ------------------
     # the fused path produces the grad DIRECTLY as a table (per-leaf
     # custom_vjp cotangent sketches — no flat [D] concat, no separate

@@ -423,6 +423,35 @@ def test_collective_axis_accepts_constants(tmp_path):
     assert not findings, findings
 
 
+def test_collective_axis_detects_hardcoded_perm_table(tmp_path):
+    """ISSUE 14 satellite: integer literals in a ppermute perm table are
+    baked device ids — valid for exactly one mesh size. Tables COMPUTED
+    from the axis size (the recursive-halving butterfly, the ring shift —
+    whose arithmetic constants live inside BinOps, not id slots) stay
+    legal."""
+    findings = _lint_dir(tmp_path, {"mod.py": (
+        "import jax\n"
+        "\n"
+        "WORKERS = 'workers'\n"
+        "\n"
+        "def bad(x):\n"
+        "    return jax.lax.ppermute(\n"
+        "        x, WORKERS, perm=[(0, 1), (1, 0)])\n"
+        "\n"
+        "def bad_positional(x):\n"
+        "    return jax.lax.ppermute(x, WORKERS, [(3, 0)])\n"
+        "\n"
+        "def good(x, axis_size, bit):\n"
+        "    butterfly = [(i, i ^ bit) for i in range(axis_size)]\n"
+        "    a = jax.lax.ppermute(x, WORKERS, perm=butterfly)\n"
+        "    ring = [(i, (i - 1) % axis_size) for i in range(axis_size)]\n"
+        "    return jax.lax.ppermute(a, WORKERS, perm=ring)\n"
+    )}, rules=["collective-axis"])
+    hits = _by_rule(findings, "collective-axis")
+    assert sorted(f.lineno for f in hits) == [7, 7, 7, 7, 10, 10], findings
+    assert all("perm table" in f.message for f in hits), findings
+
+
 # ---------------------------------------------------------------------------
 # registry-dispatch (ported analyzer; the script shim is covered by
 # tests/test_mode_dispatch.py)

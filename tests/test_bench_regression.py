@@ -214,6 +214,36 @@ def test_gpt2_sketch_gap_metrics_registered_and_gated(tmp_path):
     assert "gpt2_sketch_scan_tokens_per_sec" in names
 
 
+def test_sparse_agg_metrics_registered_and_gated(tmp_path):
+    """ISSUE 14 satellite: the sparse-aggregation bench legs gate on
+    their _vs_dense ratio (higher is better, tight 10% band — twin runs
+    of one geometry, load cancels); the bare samples/s rows stay
+    informational, and error/skip markers never gate."""
+    mod = _gate()
+    for name in ("local_topk_sparse_agg_vs_dense",
+                 "true_topk_sparse_agg_vs_dense"):
+        assert mod.metric_direction(name) == "up"
+        assert mod.tolerance_for(name, 0.15) == 0.10
+    assert mod.metric_direction("local_topk_sparse_agg") is None
+    assert mod.metric_direction("true_topk_sparse_agg") is None
+    assert mod.metric_direction("local_topk_sparse_agg_error") is None
+    assert mod.metric_direction("sparse_agg_skipped") is None
+    # detects-regression self-test: sparse advantage collapsing (1.4x ->
+    # 0.9x) past the band must gate and name the ratio
+    good = {**BASELINE, "local_topk_sparse_agg_vs_dense": 1.4}
+    bad = {**BASELINE, "local_topk_sparse_agg_vs_dense": 0.9}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", bad)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _, _ = mod.check_regression([good], bad)
+    assert [r["metric"] for r in regs] == ["local_topk_sparse_agg_vs_dense"]
+    assert regs[0]["direction"] == "up"
+    # within the band passes
+    regs, _, _ = mod.check_regression(
+        [good], {**BASELINE, "local_topk_sparse_agg_vs_dense": 1.33})
+    assert regs == []
+
+
 def test_json_summary_always_last_line(tmp_path, capsys):
     """The machine-readable summary is the last stdout line in every exit
     path (nothing-to-compare included)."""

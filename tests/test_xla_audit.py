@@ -325,6 +325,66 @@ def test_checker_enforces_wk_bound(tmp_path):
         mod.validate_perf_report(path)
 
 
+def _write_sparse_report(tmp_path):
+    cfg = Config(telemetry_level=1, mode="true_topk", k=9,
+                 topk_method="threshold", error_type="virtual",
+                 virtual_momentum=0.9, aggregate="sparse", **BASE)
+    sess, _, ids, batch = _session_and_round0(cfg)
+    audit = sess.audit_compiled_round(ids, batch, 0.2)
+    return audit.write(str(tmp_path), generated_by="test", cfg=cfg)
+
+
+def test_checker_enforces_sparse_agg_gather_bound(tmp_path):
+    """ISSUE 14 acceptance: an all-gather over the pair-exchange bound on
+    a sparse-aggregate report must FAIL the checker — the O(W*k) claim is
+    machine-enforced, not prose."""
+    mod = _checker()
+    path = _write_sparse_report(tmp_path)
+    rec = mod.validate_perf_report(path)  # genuine artifact passes
+    assert rec["aggregate"] == "sparse"
+    with open(path) as f:
+        rec = json.load(f)
+    rec["collectives"]["max_all_gather_elems"] = (
+        rec["collectives"]["sparse_agg_bound"] + 1
+    )
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="pair-exchange bound"):
+        mod.validate_perf_report(path)
+
+
+def test_checker_enforces_sparse_agg_reduce_bound(tmp_path):
+    """Same gate for all-reduce: a dense psum sneaking back into a round
+    claiming sparse aggregation is a checker failure (reduce-scatter is
+    exempt — O(D/W) per link, sharded result)."""
+    mod = _checker()
+    path = _write_sparse_report(tmp_path)
+    with open(path) as f:
+        rec = json.load(f)
+    rec["collectives"]["max_all_reduce_elems"] = (
+        rec["collectives"]["sparse_agg_bound"] + 1
+    )
+    with open(path, "w") as f:
+        json.dump(rec, f)
+    with pytest.raises(mod.SchemaError, match="all-reduce.*pair-exchange"):
+        mod.validate_perf_report(path)
+
+
+def test_checker_rejects_sparse_agg_without_bound(tmp_path):
+    """aggregate='sparse' with a missing/degenerate bound is malformed —
+    the claim would be unenforceable."""
+    mod = _checker()
+    path = _write_sparse_report(tmp_path)
+    for bad in (None, 0):
+        with open(path) as f:
+            rec = json.load(f)
+        rec["collectives"]["sparse_agg_bound"] = bad
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        with pytest.raises(mod.SchemaError, match="sparse_agg_bound"):
+            mod.validate_perf_report(path)
+
+
 def test_checker_enforces_sharded_tolerance(tmp_path):
     mod = _checker()
     path = _write_report(tmp_path)
