@@ -656,6 +656,115 @@ def _measure_sparse_agg(base, n_rounds: int = 10) -> dict:
     return out
 
 
+def _measure_async(base, n_updates: int = 8) -> dict:
+    """Buffered-async PR: the asyncfed engine vs its synchronous twin on
+    the headline sketch round under ~40% stragglers (poisson arrivals at
+    rate 0.9: participation 1-exp(-0.9) ~ 0.59). Both twins run the SAME
+    task, sampler stream, and per-client vmap round body (async requires
+    per-client rows, so the sync twin drops fuse_clients too — the ratio
+    isolates the SCHEDULE, not the fusion). The sync twin pays one full
+    barrier round per server update; the async engine fires on the Kth
+    arrival with C cohorts in flight, so it lands more server updates per
+    unit wall-clock on the same hardware budget. Reported:
+
+      * sketch_async_updates_per_sec / sketch_async_sync_rounds_per_sec —
+        server-update rates of the two twins (both gated up);
+      * sketch_async_vs_sync — their ratio (tight band in
+        scripts/check_bench_regression.py; the leg's design claim);
+      * sketch_async_time_to_loss_sec + the _vs_sync ratio — wall seconds
+        for the async run to first reach the sync twin's final training
+        loss (the staleness-discounting quality story under stragglers;
+        if never reached, the full async duration is reported — honest
+        pessimism, and the ratio then gates the shortfall);
+      * sketch_async_retraces — hard-zero invariant (one compiled
+        launch/apply pair per rung at ANY concurrency).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.asyncfed import AsyncFederation
+    from commefficient_tpu.data import FedDataset, FedSampler
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.profiling import fence
+
+    W, B = base.num_workers, base.local_batch_size
+    K, C, rate = max(W // 2, 1), 2, 0.9
+    common = dict(fuse_clients=False, device_data=False,
+                  availability="poisson", arrival_rate=rate)
+    cfg_async = base.replace(async_buffer=K, async_concurrency=C,
+                             staleness_exponent=0.5, **common)
+    cfg_sync = base.replace(**common)
+
+    model = ResNet9(num_classes=10, dtype=model_dtype(base.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply,
+                                  compute_dtype=base.compute_dtype)
+    rng = np.random.default_rng(0)
+    n = 4 * W * B
+    ds = FedDataset(
+        {"x": rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8),
+         "y": rng.integers(0, 10, size=(n,)).astype(np.int32)},
+        base.num_clients, iid=True, seed=0,
+    )
+
+    def run_sync():
+        session = FederatedSession(cfg_sync, params, loss_fn,
+                                   mesh=make_mesh(1))
+        sampler = FedSampler(ds, num_workers=W, local_batch_size=B, seed=0)
+        losses = []
+        for r in range(2):  # compile + donated-layout warmup
+            ids, batch = sampler.sample_round(r)
+            fence(session.train_round(ids, batch, 0.1)["loss"])
+        t0 = time.perf_counter()
+        for r in range(2, 2 + n_updates):
+            ids, batch = sampler.sample_round(r)
+            m = session.train_round(ids, batch, 0.1)
+            losses.append(float(fence(m["loss"])))
+        return time.perf_counter() - t0, losses
+
+    def run_async():
+        session = FederatedSession(cfg_async, params, loss_fn,
+                                   mesh=make_mesh(1))
+        sampler = FedSampler(ds, num_workers=W, local_batch_size=B, seed=0)
+        total = 2 + n_updates
+        engine = AsyncFederation(cfg_async, session, sampler,
+                                 lambda _s: 0.1, total,
+                                 steps_per_epoch=total).start()
+        losses, stamps = [], []
+        try:
+            t0 = None
+            for step, _lr, m in engine.epoch_rounds(0, 0):
+                loss = float(fence(m["loss"]))
+                if step == 1:  # warmup: both compiled layouts dispatched
+                    t0 = time.perf_counter()
+                elif step >= 2:
+                    losses.append(loss)
+                    stamps.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+        finally:
+            engine.close()
+        return dt, losses, stamps, session.retrace_sentinel.retraces
+
+    dt_sync, sync_losses = run_sync()
+    dt_async, async_losses, stamps, retraces = run_async()
+    target = sync_losses[-1]
+    reached = [t for t, l in zip(stamps, async_losses) if l <= target]
+    t2l = reached[0] if reached else dt_async
+    return {
+        "sketch_async_buffer": K,
+        "sketch_async_concurrency": C,
+        "sketch_async_straggler_rate": round(float(np.exp(-rate)), 3),
+        "sketch_async_updates_per_sec": round(n_updates / dt_async, 3),
+        "sketch_async_sync_rounds_per_sec": round(n_updates / dt_sync, 3),
+        "sketch_async_vs_sync": round(dt_sync / dt_async, 3),
+        "sketch_async_time_to_loss_sec": round(t2l, 3),
+        "sketch_async_time_to_loss_vs_sync": round(dt_sync / t2l, 3),
+        "sketch_async_retraces": retraces,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -773,6 +882,18 @@ def main():
         else:
             rows.update(sa)
             print(json.dumps({"metric": "sparse_agg", **sa}))
+        # asyncfed PR: the buffered-async engine vs its synchronous twin
+        # under ~40% poisson stragglers — server-update rate, time to the
+        # sync twin's final loss, and the hard-zero retrace invariant
+        try:
+            asy = _measure_async(base)
+        except Exception as e:  # noqa: BLE001
+            rows["sketch_async_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "sketch_async",
+                              "error": rows["sketch_async_error"]}))
+        else:
+            rows.update(asy)
+            print(json.dumps({"metric": "sketch_async", **asy}))
 
     # pipeline PR: the pipelined-execution leg rides the HEADLINE line
     # (gated by scripts/check_bench_regression.py — occupancy + samples/s

@@ -153,6 +153,11 @@ class BudgetController:
         rung would overshoot the budget — BEFORE the round runs."""
         live, avail = self._live_avail(fs_stats)
         rung = self.session.active_rung
+        s = fs_stats or {}
+        # buffered-async per-update signals (asyncfed/engine.py rides them
+        # in fs_stats unconditionally) — None on synchronous rounds
+        stale = s.get("async/staleness_mean")
+        eff = s.get("async/effective_participation")
         target = self.policy.decide(DecisionContext(
             step=step, num_rounds=self.num_rounds, rung=rung,
             num_rungs=self.num_rungs,
@@ -160,6 +165,8 @@ class BudgetController:
             spent_bytes=self.spent_bytes, budget_bytes=self.budget_bytes,
             last_switch_round=self.last_switch_round,
             hysteresis=self.cfg.control_hysteresis,
+            staleness_mean=None if stale is None else float(stale),
+            effective_participation=None if eff is None else float(eff),
         ))
         target = min(max(int(target), 0), self.num_rungs - 1)
         # resilience demotion floor: a divergence-demoted run never climbs

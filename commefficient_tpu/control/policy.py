@@ -117,7 +117,8 @@ class DecisionContext:
     def __init__(self, *, step: int, num_rounds: int, rung: int,
                  num_rungs: int, round_bytes, spent_bytes: int,
                  budget_bytes: Optional[int], last_switch_round: int,
-                 hysteresis: int):
+                 hysteresis: int, staleness_mean: Optional[float] = None,
+                 effective_participation: Optional[float] = None):
         self.step = step
         self.num_rounds = num_rounds
         self.rung = rung
@@ -129,6 +130,12 @@ class DecisionContext:
         self.budget_bytes = budget_bytes
         self.last_switch_round = last_switch_round
         self.hysteresis = hysteresis
+        # v8 buffered-async per-update signals (asyncfed/engine.py):
+        # None on synchronous rounds. Available to policies as observables
+        # — none of the shipped policies key decisions off them yet, so
+        # sync/async rung sequences stay comparable run-to-run.
+        self.staleness_mean = staleness_mean
+        self.effective_participation = effective_participation
 
 
 class ControlPolicy:

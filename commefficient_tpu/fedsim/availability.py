@@ -36,8 +36,8 @@ _REGISTRY: Dict[str, Callable] = {}
 def register_availability(name: str):
     """Register an availability model under ``name`` (the cfg.availability
     value). Models are ``fn(rng, round_idx, *, num_workers, dropout_prob,
-    period, num_cohorts) -> bool [num_workers]`` — True = the slot's client
-    is available this round."""
+    period, num_cohorts, rate) -> bool [num_workers]`` — True = the slot's
+    client is available this round."""
 
     def deco(fn):
         fn.availability_name = name
@@ -68,6 +68,7 @@ def sample_availability(
     dropout_prob: float = 0.0,
     period: int = 64,
     num_cohorts: int = 4,
+    rate: float = 1.0,
 ) -> np.ndarray:
     """One round's availability mask from the named model."""
     try:
@@ -84,13 +85,14 @@ def sample_availability(
         dropout_prob=dropout_prob,
         period=period,
         num_cohorts=num_cohorts,
+        rate=rate,
     )
     return np.asarray(mask, bool)
 
 
 @register_availability("always")
 def _always(rng, round_idx, *, num_workers, dropout_prob, period,
-            num_cohorts):
+            num_cohorts, rate):
     """Every client arrives every round — the reference's implicit model.
     The round builders never trace masking for it (cfg.fedsim_enabled is
     False), so this function only runs when composed under chaos."""
@@ -99,7 +101,7 @@ def _always(rng, round_idx, *, num_workers, dropout_prob, period,
 
 @register_availability("bernoulli")
 def _bernoulli(rng, round_idx, *, num_workers, dropout_prob, period,
-               num_cohorts):
+               num_cohorts, rate):
     """IID per-client dropout: each slot independently misses the round
     with probability ``dropout_prob``."""
     return rng.random(num_workers) >= dropout_prob
@@ -107,7 +109,7 @@ def _bernoulli(rng, round_idx, *, num_workers, dropout_prob, period,
 
 @register_availability("sine")
 def _sine(rng, round_idx, *, num_workers, dropout_prob, period,
-          num_cohorts):
+          num_cohorts, rate):
     """Diurnal participation: the per-client drop probability oscillates
     ``0 .. dropout_prob`` over ``period`` rounds (phones charge at night;
     FetchSGD §1's motivating deployment). Round 0 sits at the mean."""
@@ -117,7 +119,7 @@ def _sine(rng, round_idx, *, num_workers, dropout_prob, period,
 
 @register_availability("cohort")
 def _cohort(rng, round_idx, *, num_workers, dropout_prob, period,
-            num_cohorts):
+            num_cohorts, rate):
     """Correlated outages: worker slots are partitioned into
     ``num_cohorts`` groups (slot i -> cohort i % num_cohorts — a regional
     backbone / carrier model), and each cohort is out IN ITS ENTIRETY with
@@ -127,3 +129,31 @@ def _cohort(rng, round_idx, *, num_workers, dropout_prob, period,
     out = rng.random(num_cohorts) < dropout_prob
     cohort_of = np.arange(num_workers) % num_cohorts
     return ~out[cohort_of]
+
+
+@register_availability("poisson")
+def _poisson(rng, round_idx, *, num_workers, dropout_prob, period,
+             num_cohorts, rate):
+    """Arrival-time availability (the asyncfed/ cohort model): each slot's
+    client draws an exponential arrival delay with rate ``rate``
+    (``cfg.arrival_rate``, mean delay 1/rate in round-deadline units) and
+    makes the round iff it arrives within one deadline — so the marginal
+    participation probability is ``1 - exp(-rate)``, and ``rate -> inf``
+    degenerates to ``always`` (delay 0). Composes with IID dropout
+    (``dropout_prob``): a client can be reachable yet decline, matching the
+    bernoulli model's knob so the fedsim determinism/unbiasedness tests
+    parametrize over this model unchanged. Both draws happen
+    unconditionally so the shared round rng's cursor — and therefore the
+    chaos draws that follow it (env.py draw order) — is knob-independent.
+
+    The asyncfed schedule draws PER-COHORT delays from its own stream
+    (asyncfed/schedule.py, ASYNC_STREAM) to order arrivals in continuous
+    time; this round-granular projection of the same process is what
+    synchronous fedsim runs see."""
+    scale = 0.0 if np.isinf(rate) else 1.0 / rate
+    # unit draws scaled after the fact (not exponential(scale, .)) so the
+    # rng cursor really is knob-independent even at rate=inf
+    delays = rng.exponential(1.0, num_workers) * scale
+    arrived = delays <= 1.0
+    declined = rng.random(num_workers) < dropout_prob
+    return arrived & ~declined

@@ -59,6 +59,9 @@ class FedEnvironment:
         self.dropout_prob = float(cfg.dropout_prob)
         self.period = int(cfg.availability_period)
         self.num_cohorts = int(cfg.num_cohorts)
+        # getattr: older duck-typed cfg stand-ins (tests, bench shims)
+        # predate the poisson model's knob
+        self.arrival_rate = float(getattr(cfg, "arrival_rate", 1.0))
         self.plan: Tuple[ChaosEvent, ...] = parse_chaos(cfg.chaos)
 
     def describe(self) -> str:
@@ -99,6 +102,7 @@ class FedEnvironment:
             self.availability, rng, round_idx,
             num_workers=W, dropout_prob=self.dropout_prob,
             period=self.period, num_cohorts=self.num_cohorts,
+            rate=self.arrival_rate,
         )
         avail, straggler, corrupt = apply_chaos(
             self.plan, rng, round_idx, avail, replay=replay

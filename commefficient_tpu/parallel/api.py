@@ -164,6 +164,11 @@ class FederatedSession:
         self.retrace_sentinel = RetraceSentinel(
             max_retraces=cfg.max_retraces, name="round_fn"
         )
+        # asyncfed (launch_fn, apply_fn) pairs, one per rung, built lazily
+        # and SHARED between the perf-observability audit and the engine —
+        # two builds would feed one sentinel stream and count phantom
+        # retraces.
+        self._async_programs: Dict[int, Any] = {}
         # host-side phase-span recorder (telemetry/spans.py); a train loop
         # attaches one at telemetry_level >= 1 — None keeps every span
         # site on the zero-cost fast path.
@@ -1067,11 +1072,12 @@ class FederatedSession:
         artifact for both entry paths. Pure observer: no state, round
         clock, or donation side effects.
         """
-        from commefficient_tpu.telemetry.xla_audit import (
-            CompiledRoundAudit,
-            ledger_tolerance,
-        )
+        from commefficient_tpu.telemetry.xla_audit import CompiledRoundAudit
 
+        if self.cfg.asyncfed_enabled:
+            return self._audit_compiled_async_round(
+                client_ids, batch, lr, env=env
+            )
         cids = np.asarray(client_ids)
         ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         dev_batch = jax.tree.map(
@@ -1093,6 +1099,20 @@ class FederatedSession:
         fs_env, _ = self._fedsim_round_env(env)
         lowered = self.round_fn.lower(*args, env=fs_env)
         compiled = lowered.compile()
+        return CompiledRoundAudit.from_compiled(
+            compiled,
+            engine="fsdp" if self.cfg.fsdp else "replicated",
+            **self._audit_bounds(cids),
+        )
+
+    def _audit_bounds(self, cids) -> Dict[str, Any]:
+        """The ledger/collective bounds every compiled-round audit is
+        checked against — shared by the synchronous and asyncfed audits
+        (the bounds depend on the active rung's geometry, not on which
+        engine dispatches the program)."""
+        from commefficient_tpu.telemetry.xla_audit import ledger_tolerance
+
+        cids = np.asarray(cids)
         W = self._n_mesh_devices
         # capability, not a mode string (scripts/check_mode_dispatch.py):
         # only compressors with a server-decode strategy knob report one
@@ -1137,9 +1157,7 @@ class FederatedSession:
                 sparse_agg_bound = max(
                     sparse_agg_bound, cids.shape[0] * self.grad_size
                 )
-        return CompiledRoundAudit.from_compiled(
-            compiled,
-            engine="fsdp" if self.cfg.fsdp else "replicated",
+        return dict(
             mode=self.cfg.mode,
             sketch_decode=self.sketch_decode_resolved if is_sketch else None,
             aggregate=aggregate,
@@ -1151,6 +1169,83 @@ class FederatedSession:
             tolerance_bytes=ledger_tolerance(
                 up, sharded=sharded, workers=W, k=k_active
             ),
+        )
+
+    # -- asyncfed programs -------------------------------------------------
+    def async_round_fns(self, rung_index: Optional[int] = None):
+        """The asyncfed ``(launch_fn, apply_fn)`` pair for one rung,
+        built lazily and cached on the SESSION so the perf-observability
+        audit (which the runner builds first) and the engine dispatch the
+        same jitted objects — one trace cache, one sentinel stream per
+        rung, zero phantom retraces."""
+        # lazy: parallel.__init__ -> api would otherwise cycle through
+        # asyncfed.round -> parallel.round
+        from commefficient_tpu.asyncfed.round import build_async_round_fns
+
+        idx = self.active_rung if rung_index is None else int(rung_index)
+        cached = self._async_programs.get(idx)
+        if cached is not None:
+            return cached
+        rung = self.rungs[idx]
+        pair = build_async_round_fns(
+            rung.cfg, self._loss_fn, self.unravel, self.mesh, rung.spec,
+            d=self.grad_size,
+            launch_hook=self.retrace_sentinel.hook_for(
+                _rung_hook_name(rung.label, "async_launch_fn")
+            ),
+            apply_hook=self.retrace_sentinel.hook_for(
+                _rung_hook_name(rung.label, "async_apply_fn")
+            ),
+        )
+        self._async_programs[idx] = pair
+        return pair
+
+    def _audit_compiled_async_round(self, client_ids, batch, lr, env=None):
+        """The asyncfed variant of the compiled-round audit: RUN the
+        launch program once (pure — donates nothing, touches no state) to
+        obtain concrete apply inputs, then AOT-compile the apply — the
+        phase that carries every collective — and audit it against the
+        same ledger/collective bounds as the synchronous round. Doubles
+        as the engine's warmup: both programs are traced here, so a clean
+        run's sentinel stays at zero retraces at any buffer/concurrency.
+        """
+        from commefficient_tpu.telemetry.xla_audit import CompiledRoundAudit
+
+        cfg = self.cfg
+        launch_fn, apply_fn = self.async_round_fns(self.active_rung)
+        cids = np.asarray(client_ids)
+        ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
+        dev_batch = jax.tree.map(
+            lambda a: jax.device_put(jnp.asarray(a), self._batch_sharding),
+            batch,
+        )
+        fs_env, _ = self._fedsim_round_env(env, client_ids=cids)
+        # launch_fn takes (live, corrupt) only — the count is an apply-
+        # side quantity (wsum) in the async round
+        launch_env = tuple(fs_env[:2]) if fs_env else ()
+        st = self.state
+        out = launch_fn(
+            st.params_vec, st.client_vel, st.client_err, ids, dev_batch,
+            jnp.int32(0), jnp.float32(lr), env=launch_env,
+        )
+        W = cfg.num_workers
+        weights = jax.device_put(
+            jnp.ones((W,), jnp.float32), self._batch_sharding
+        )
+        # lower() never executes, so donation stays un-triggered and the
+        # session state survives the audit untouched
+        compiled = apply_fn.lower(
+            self.state, *out, ids, weights, jnp.float32(W), jnp.float32(lr)
+        ).compile()
+        return CompiledRoundAudit.from_compiled(
+            compiled,
+            engine="async",
+            async_info={
+                "buffer": int(cfg.async_buffer),
+                "concurrency": int(cfg.async_concurrency),
+                "staleness_exponent": float(cfg.staleness_exponent),
+            },
+            **self._audit_bounds(cids),
         )
 
     def bytes_per_round(self) -> Dict[str, int]:

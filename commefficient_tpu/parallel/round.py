@@ -318,6 +318,289 @@ def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *,
     )
 
 
+def make_per_client(cfg: Config, comp, grad_one, *, use_fedsim: bool):
+    """The per-client compute shared by the synchronous worker shard and the
+    asyncfed launch program (asyncfed/round.py): gradient -> local momentum
+    -> the compressor's transmit rule -> fedsim corrupt/live masking.
+    Extracted verbatim from ``worker_shard`` so the two traces cannot drift
+    (the K=W/C=1 bit-identity anchor in tests/test_asyncfed.py depends on
+    it). ``params_vec``/``rng``/``lr`` are explicit arguments so callers may
+    close over a round-level rng (sync: fold_in(key, state.step)) or a
+    launch-version rng (async: fold_in(key, version)) — identical values at
+    the anchor."""
+    lm = cfg.local_momentum
+
+    def per_client(params_vec, b, cid, vel, err, rng, lr, m=None, c=None):
+        noise_rng = jax.random.fold_in(rng, cid)
+        g, loss, aux = comp.client_grad(grad_one, params_vec, b, noise_rng, lr)
+        u = lm * vel + g if lm > 0 else g
+        # the compressor's per-client transmit rule (local_topk: local
+        # error feedback + top-k + momentum masking). Dense-transmit
+        # modes return u itself: by linearity of device_encode,
+        # encode(sum of local clients' u) == sum of their encodings, so
+        # each device encodes ONCE downstream instead of per client (8x
+        # fewer sketches per chip; ICI still carries only the encoding).
+        transmit, new_vel, new_err = comp.client_transmit(u, err, lr)
+        if use_fedsim:
+            # masked aggregation (fedsim/): chaos corruption NaNs a
+            # client's payload FIRST (so the flight-recorder/
+            # DivergenceError path is exercised end-to-end), then the
+            # live mask zeroes every non-participant's transmit —
+            # jnp.where, not multiply, so a zero mask blocks even a
+            # corrupted payload's NaN (0 * nan == nan): only a LIVE
+            # corrupted client can poison the aggregate. A masked-out
+            # client's local momentum/error rows carry forward
+            # unmodified (it never participated; reference per-client-
+            # state semantics).
+            transmit = jnp.where(c > 0, jnp.float32(jnp.nan), transmit)
+            transmit = jnp.where(m > 0, transmit, 0.0)
+            loss = loss * m
+            aux = jax.tree.map(lambda a: a * m, aux)
+            if lm > 0:
+                new_vel = jnp.where(m > 0, new_vel, vel)
+            if cfg.error_type == "local":
+                new_err = jnp.where(m > 0, new_err, err)
+        return transmit, new_vel, new_err, loss, aux
+
+    return per_client
+
+
+class AggregationPlan(NamedTuple):
+    """Trace-time resolution of the aggregation + server-decode strategy
+    (cfg.aggregate / cfg.sketch_decode x compressor capability x mesh) —
+    shared by the synchronous round and the asyncfed apply program so the
+    two resolve identically for a given rung config."""
+
+    use_sparse_agg: bool
+    sparse_state: bool  # true_topk sparse agg: server state workers-sharded
+    sparse_gather: bool  # local_topk: W*k-pair all_gather rebuild
+    sharded_decode: bool  # sketch: per-chip slice decode
+    sparse_apply: bool  # either sparse decode: (idx, val) candidate apply
+
+
+def resolve_aggregation(cfg: Config, comp, Wd: int) -> AggregationPlan:
+    use_sparse_agg = comp.use_sparse_aggregate(Wd)
+    sparse_state = use_sparse_agg and comp.sparse_aggregate_shards_state
+    sparse_gather = (use_sparse_agg and not sparse_state
+                     and not comp.needs_sketch_spec)
+    sharded_decode = comp.use_sharded_decode(Wd)
+    return AggregationPlan(
+        use_sparse_agg=use_sparse_agg,
+        sparse_state=sparse_state,
+        sparse_gather=sparse_gather,
+        sharded_decode=sharded_decode,
+        sparse_apply=sharded_decode or sparse_state,
+    )
+
+
+def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
+                        W: int, Wd: int, d: int):
+    """The cross-worker aggregation tail, called INSIDE a shard_map body
+    over the workers axis: ``(local encoded transmit sum, loss_local, aux
+    tree, w_loc) -> (agg, loss_mean, aux_sum)``. Extracted verbatim from
+    ``worker_shard`` so the synchronous round and the asyncfed apply
+    program share one collective layout per plan."""
+
+    def aggregate_tail(local, loss_local, aux, w_loc):
+        aux_leaves, aux_def = jax.tree.flatten(aux)
+        if plan.sparse_state:
+            # true_topk sparse aggregation: reduce-scatter the dense
+            # transmit sum — each chip keeps only its balanced [S] slice
+            # of the padded [dp] vector (no O(D) all-reduce ever; the
+            # server algebra downstream is sharded to match)
+            dp = Wd * -(-d // Wd)
+            agg = (
+                jax.lax.psum_scatter(
+                    jnp.pad(local, (0, dp - d)), WORKERS,
+                    scatter_dimension=0, tiled=True,
+                )
+                / W
+            )
+            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        elif plan.sparse_gather:
+            # local_topk sparse aggregation: the device's summed transmit
+            # has <= w_loc*k nonzeros (each client sends <= k), so one
+            # W*k-pair all_gather + scatter-add rebuilds the replicated
+            # dense aggregate — equal to the psum up to f32 summation
+            # order, and everything downstream is byte-for-byte the dense
+            # server path
+            with jax.named_scope("sparse_allreduce"):
+                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS) / W
+            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        else:
+            # dense path: ONE fused all-reduce carries agg+loss+aux (the
+            # bf16 sketch table keeps its own psum — see _psum_fused)
+            fused_sum = _psum_fused([local, loss_local] + aux_leaves,
+                                    WORKERS)
+            agg = fused_sum[0] / W
+            summed = fused_sum[1:]
+        loss_mean = summed[0] / W
+        aux_sum = jax.tree.unflatten(aux_def, summed[1:])
+        return agg, loss_mean, aux_sum
+
+    return aggregate_tail
+
+
+def make_decode_mapped(cfg: Config, comp, mesh, plan: AggregationPlan, *,
+                       d: int, Wd: int):
+    """The sharded server decode shard_map (None when the plan applies the
+    dense decode). Resolved at trace time — a python-level gate like
+    telemetry_level/fedsim, so the dense round's trace is untouched when
+    off (golden recordings pin it). When on, the server update runs INSIDE
+    a second shard_map over the same workers axis: each chip decodes only
+    its D/W coordinate slice and the round applies the gathered ~W*k
+    (idx, val) candidates as a k-sparse scatter — no [D] estimate, no [D]
+    unsketch transient, no dense re-sketch, no D-sized collective (pinned
+    by the HLO test in tests/test_sketch_decode.py)."""
+    if not plan.sparse_apply:
+        return None
+    _, e_kind = comp.server_state_kinds()
+
+    def decode_shard(momentum, error, comp_state, agg, lr, step):
+        if plan.sparse_state:
+            return comp.server_update_sparse(
+                momentum, error, comp_state, agg, lr, step,
+                axis_name=WORKERS, Wd=Wd, d=d,
+            )
+        return comp.server_update_sharded(
+            momentum, error, comp_state, agg, lr, step,
+            axis_name=WORKERS, Wd=Wd, d=d,
+        )
+
+    st_spec = P(WORKERS) if plan.sparse_state else P()
+    e_spec = (
+        P(WORKERS) if plan.sparse_state and e_kind == KIND_DENSE else P()
+    )
+    return shard_map(
+        decode_shard,
+        mesh=mesh,
+        in_specs=(st_spec, e_spec, P(), st_spec, P(), P()),
+        out_specs=(P(), P(), st_spec, e_spec, P()),
+    )
+
+
+def server_phase(cfg: Config, comp, plan: AggregationPlan, decode_mapped,
+                 state: FedState, agg, loss, aux, lr, *,
+                 count=None, client_err_rows=None):
+    """The server half of a round (fed_aggregator _server_helper_*
+    ~L380-540), shared by the synchronous round and the asyncfed apply
+    program: live-count renormalization -> the compressor's momentum/error
+    algebra + update extraction -> the nothing-arrived guard -> params
+    apply -> metrics/telemetry assembly.
+
+    ``count``: the traced effective-participation scalar (fedsim live
+    count; asyncfed: the staleness-weight sum). ``None`` is a PYTHON-level
+    gate — no renorm and no guard are traced at all, the pre-fedsim
+    synchronous program. Returns ``(new_params, new_m, new_e, new_comp,
+    metrics)``; the caller owns the client-state row scatter and FedState
+    assembly (sync scatters once; async writes back in arrival order)."""
+    W = cfg.num_workers
+    if count is not None:
+        # renormalize by the LIVE count: the shard body averaged the
+        # psum by W with the dead clients' terms zeroed, and every
+        # device_encode is linear (compress/ psum-safety contract), so
+        # the scalar correction commutes with the encode for all modes
+        # — a masked round with live cohort S equals an unmasked round
+        # over exactly S (tests/test_fedsim.py). The max(count, 1)
+        # guard keeps an all-dropped round finite; its whole server
+        # update is frozen below.
+        scale = W / jnp.maximum(count, 1.0)
+        agg = agg * scale
+        loss = loss * scale  # loss becomes the mean over LIVE clients
+    if plan.sparse_apply:
+        # sparse apply: each chip extracts its D/W slice inside the
+        # shard_map; the replicated outputs are the gathered ~Wd*k
+        # (idx, val) candidate buffers (val==0 padding) + the updated
+        # server-state leaves (replicated tables for the sketch
+        # decode; workers-sharded [dp] vectors under true_topk sparse
+        # aggregation). The update applies as a k-sparse scatter —
+        # the dense [D] delta never exists. (do_topk_down is moot
+        # here: every sparse-apply mode has dense_delta=False — the
+        # candidates are already <= k pairs.)
+        scope = ("sketch_decode_sharded" if plan.sharded_decode
+                 else "sparse_aggregate_decode")
+        with jax.named_scope(scope):
+            g_idx, g_val, new_m, new_e, new_comp = decode_mapped(
+                state.momentum, state.error, state.comp, agg, lr,
+                state.step,
+            )
+    else:
+        # dense decode (legacy path): the compressor returns the
+        # APPLIED delta (w -= delta), full-[D] on every chip. The
+        # named_scope is an HLO marker like telemetry_diag's: its
+        # absence from the compiled sharded round proves this branch
+        # was never traced (tests/test_sketch_decode.py).
+        with jax.named_scope("server_decode_dense"):
+            delta, new_m, new_e, new_comp = comp.server_update(
+                state.momentum, state.error, state.comp, agg, lr,
+                state.step,
+            )
+        if cfg.do_topk_down and comp.dense_delta:
+            # downlink compression (reference down-compression flag):
+            # the broadcast weight delta is itself top-k sparsified, so
+            # the download really is 2k floats (bytes_per_round
+            # accounting). Lossy by design, as in the reference —
+            # coordinates dropped here are NOT re-banked into client
+            # error. Skipped for compressors whose delta is already
+            # compressed (sketch/true_topk: <= k nonzeros; powersgd:
+            # rank-r factored — a full-[D] selection there would be a
+            # pure waste).
+            delta = comp.topk(delta, cfg.k)
+    if count is not None:
+        # all-clients-dropped guard: nothing arrived, so nothing may
+        # move — params freeze (the dense delta, or the sharded
+        # candidate VALUES whose scatter then adds 0.0, zero out) and
+        # every server-state leaf (momentum/error/compressor-private)
+        # carries forward; the host-side fedsim/all_dropped sentinel
+        # rides the metrics instead of a 0/0 poisoning the run
+        ok = count > 0
+
+        def keep(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                new, old)
+
+        if plan.sparse_apply:
+            g_val = jnp.where(ok, g_val, 0.0)
+        else:
+            delta = jnp.where(ok, delta, 0.0)
+        new_m = keep(new_m, state.momentum)
+        new_e = keep(new_e, state.error)
+        new_comp = keep(new_comp, state.comp)
+    new_params = (
+        state.params_vec.at[g_idx].add(-g_val)
+        if plan.sparse_apply
+        else state.params_vec - delta
+    )
+    metrics = {"loss": loss, **aux}
+    if cfg.telemetry_level >= 1:
+        # in-graph health diagnostics (telemetry/diagnostics.py): ride
+        # the metrics dict -> the deferred drain path, no extra
+        # fences. The gate is python-level at trace time, so level 0
+        # traces NOTHING here (bit-identical round; HLO smoke test).
+        with jax.named_scope("telemetry_diag"):
+            common = dict(
+                agg=agg, new_params=new_params, loss=loss, lr=lr,
+                momentum=state.momentum, error=state.error,
+                extra=state.comp, new_error=new_e,
+            )
+            metrics.update(
+                round_diagnostics_sparse(
+                    cfg, comp, idx=g_idx, val=g_val, **common
+                )
+                if plan.sparse_apply
+                else round_diagnostics(
+                    cfg, comp, delta=delta,
+                    client_err_rows=(
+                        client_err_rows
+                        if cfg.error_type == "local"
+                        else None
+                    ),
+                    **common,
+                )
+            )
+    return new_params, new_m, new_e, new_comp, metrics
+
+
 def build_round_fn(
     cfg: Config,
     loss_fn: Callable,
@@ -426,10 +709,11 @@ def build_round_fn(
     # EF re-sketch ride lives inside the compressor (compress/sketch.py
     # _ride_pair_exchange); its table psum is already O(r*c), not O(D).
     Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
-    use_sparse_agg = comp.use_sparse_aggregate(Wd)
-    sparse_state = use_sparse_agg and comp.sparse_aggregate_shards_state
-    sparse_gather = (use_sparse_agg and not sparse_state
-                     and not comp.needs_sketch_spec)
+    plan = resolve_aggregation(cfg, comp, Wd)
+    sparse_state = plan.sparse_state
+
+    per_client = make_per_client(cfg, comp, grad_one, use_fedsim=use_fedsim)
+    aggregate_tail = make_aggregate_tail(cfg, comp, plan, W=W, Wd=Wd, d=d)
 
     # ---- the shard body: this IS the worker process ----------------------
     def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng,
@@ -445,40 +729,6 @@ def build_round_fn(
         # compression below see each client's own gradient; aggregation then
         # happens exactly once, at the explicit psum.
         params_vec = pcast(params_vec, WORKERS, to="varying")
-
-        def per_client(b, cid, vel, err, m=None, c=None):
-            noise_rng = jax.random.fold_in(rng, cid)
-            g, loss, aux = comp.client_grad(
-                grad_one, params_vec, b, noise_rng, lr
-            )
-            u = lm * vel + g if lm > 0 else g
-            # the compressor's per-client transmit rule (local_topk: local
-            # error feedback + top-k + momentum masking). Dense-transmit
-            # modes return u itself: by linearity of device_encode,
-            # encode(sum of local clients' u) == sum of their encodings, so
-            # each device encodes ONCE below instead of per client (8x
-            # fewer sketches per chip; ICI still carries only the encoding).
-            transmit, new_vel, new_err = comp.client_transmit(u, err, lr)
-            if use_fedsim:
-                # masked aggregation (fedsim/): chaos corruption NaNs a
-                # client's payload FIRST (so the flight-recorder/
-                # DivergenceError path is exercised end-to-end), then the
-                # live mask zeroes every non-participant's transmit —
-                # jnp.where, not multiply, so a zero mask blocks even a
-                # corrupted payload's NaN (0 * nan == nan): only a LIVE
-                # corrupted client can poison the aggregate. A masked-out
-                # client's local momentum/error rows carry forward
-                # unmodified (it never participated; reference per-client-
-                # state semantics).
-                transmit = jnp.where(c > 0, jnp.float32(jnp.nan), transmit)
-                transmit = jnp.where(m > 0, transmit, 0.0)
-                loss = loss * m
-                aux = jax.tree.map(lambda a: a * m, aux)
-                if lm > 0:
-                    new_vel = jnp.where(m > 0, new_vel, vel)
-                if cfg.error_type == "local":
-                    new_err = jnp.where(m > 0, new_err, err)
-            return transmit, new_vel, new_err, loss, aux
 
         w_loc = client_ids.shape[0]
         if fused and sketch_fused:
@@ -511,48 +761,18 @@ def build_round_fn(
             )
             # fs is (live, corrupt) under fedsim, () otherwise — per_client
             # defaults m/c to None, so one call site serves both traces
-            transmit, new_vel, new_err, loss, aux = jax.vmap(per_client)(
-                batch, client_ids, vels, errs, *fs
-            )
+            transmit, new_vel, new_err, loss, aux = jax.vmap(
+                lambda b, cid, vel, err, *fs_: per_client(
+                    params_vec, b, cid, vel, err, rng, lr, *fs_
+                )
+            )(batch, client_ids, vels, errs, *fs)
             local = jnp.sum(transmit, axis=0)
             loss_local = jnp.sum(loss)
             aux = jax.tree.map(lambda a: jnp.sum(a, 0), aux)
         if not (fused and sketch_fused):  # fused-bwd already encoded above
             local = comp.device_encode(local)  # linear -> psum is exact
-        aux_leaves, aux_def = jax.tree.flatten(aux)
-        if sparse_state:
-            # true_topk sparse aggregation: reduce-scatter the dense
-            # transmit sum — each chip keeps only its balanced [S] slice
-            # of the padded [dp] vector (no O(D) all-reduce ever; the
-            # server algebra downstream is sharded to match)
-            dp = Wd * -(-d // Wd)
-            agg = (
-                jax.lax.psum_scatter(
-                    jnp.pad(local, (0, dp - d)), WORKERS,
-                    scatter_dimension=0, tiled=True,
-                )
-                / W
-            )
-            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
-        elif sparse_gather:
-            # local_topk sparse aggregation: the device's summed transmit
-            # has <= w_loc*k nonzeros (each client sends <= k), so one
-            # W*k-pair all_gather + scatter-add rebuilds the replicated
-            # dense aggregate — equal to the psum up to f32 summation
-            # order, and everything downstream is byte-for-byte the dense
-            # server path
-            with jax.named_scope("sparse_allreduce"):
-                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS) / W
-            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
-        else:
-            # dense path: ONE fused all-reduce carries agg+loss+aux (the
-            # bf16 sketch table keeps its own psum — see _psum_fused)
-            fused_sum = _psum_fused([local, loss_local] + aux_leaves,
-                                    WORKERS)
-            agg = fused_sum[0] / W
-            summed = fused_sum[1:]
-        loss_mean = summed[0] / W
-        aux_sum = jax.tree.unflatten(aux_def, summed[1:])
+        agg, loss_mean, aux_sum = aggregate_tail(local, loss_local, aux,
+                                                 w_loc)
         return agg, loss_mean, aux_sum, new_vel, new_err
 
     shard_spec = P(WORKERS)
@@ -570,47 +790,13 @@ def build_round_fn(
     )
 
     # ---- sharded server decode (the FSDP decode discipline on replicated
-    # state; compress/sketch.py server_update_sharded): resolved at trace
-    # time from cfg.sketch_decode + the compressor capability + the mesh —
-    # a python-level gate like telemetry_level/fedsim, so the dense round's
-    # trace is untouched when off (golden recordings pin it). When on, the
-    # server update runs INSIDE a second shard_map over the same workers
-    # axis: each chip decodes only its D/W coordinate slice and the round
-    # applies the gathered ~W*k (idx, val) candidates as a k-sparse
-    # scatter — no [D] estimate, no [D] unsketch transient, no dense
-    # re-sketch, no D-sized collective (pinned by the HLO test in
-    # tests/test_sketch_decode.py).
-    sharded_decode = comp.use_sharded_decode(Wd)
-    # both sparse-apply decodes return gathered (idx, val) candidate pair
-    # buffers instead of a dense delta; only the STATE placement differs
-    # (sketch: replicated tables, sharded extraction; true_topk sparse
-    # aggregation: momentum/error themselves sharded over workers)
-    sparse_apply = sharded_decode or sparse_state
-    decode_mapped = None
-    if sparse_apply:
-        _, e_kind = comp.server_state_kinds()
-
-        def decode_shard(momentum, error, comp_state, agg, lr, step):
-            if sparse_state:
-                return comp.server_update_sparse(
-                    momentum, error, comp_state, agg, lr, step,
-                    axis_name=WORKERS, Wd=Wd, d=d,
-                )
-            return comp.server_update_sharded(
-                momentum, error, comp_state, agg, lr, step,
-                axis_name=WORKERS, Wd=Wd, d=d,
-            )
-
-        st_spec = P(WORKERS) if sparse_state else P()
-        e_spec = (
-            P(WORKERS) if sparse_state and e_kind == KIND_DENSE else P()
-        )
-        decode_mapped = shard_map(
-            decode_shard,
-            mesh=mesh,
-            in_specs=(st_spec, e_spec, P(), st_spec, P(), P()),
-            out_specs=(P(), P(), st_spec, e_spec, P()),
-        )
+    # state; compress/sketch.py server_update_sharded) — see
+    # make_decode_mapped. Both sparse-apply decodes return gathered
+    # (idx, val) candidate pair buffers instead of a dense delta; only the
+    # STATE placement differs (sketch: replicated tables, sharded
+    # extraction; true_topk sparse aggregation: momentum/error themselves
+    # sharded over workers).
+    decode_mapped = make_decode_mapped(cfg, comp, mesh, plan, d=d, Wd=Wd)
 
     def round_fn(state: FedState, client_ids, batch, lr, vel_rows=(),
                  err_rows=(), env=()):
@@ -647,113 +833,17 @@ def build_round_fn(
             state.params_vec, batch, client_ids, vel_rows, err_rows, rng, lr,
             *fs
         )
-        if use_fedsim:
-            # renormalize by the LIVE count: the shard body averaged the
-            # psum by W with the dead clients' terms zeroed, and every
-            # device_encode is linear (compress/ psum-safety contract), so
-            # the scalar correction commutes with the encode for all modes
-            # — a masked round with live cohort S equals an unmasked round
-            # over exactly S (tests/test_fedsim.py). The max(live, 1)
-            # guard keeps an all-dropped round finite; its whole server
-            # update is frozen below.
-            scale = W / jnp.maximum(live_count, 1.0)
-            agg = agg * scale
-            loss = loss * scale  # loss becomes the mean over LIVE clients
         # ---- server update (fed_aggregator _server_helper_* ~L380-540):
-        # the compressor's momentum/error algebra + update extraction.
-        # Only how the update is OBTAINED and APPLIED differs between the
-        # decode paths; the fedsim all-dropped guard, the state merges,
-        # and the metrics/telemetry assembly below are shared so their
-        # semantics cannot drift between decodes.
-        if sparse_apply:
-            # sparse apply: each chip extracts its D/W slice inside the
-            # shard_map; the replicated outputs are the gathered ~Wd*k
-            # (idx, val) candidate buffers (val==0 padding) + the updated
-            # server-state leaves (replicated tables for the sketch
-            # decode; workers-sharded [dp] vectors under true_topk sparse
-            # aggregation). The update applies as a k-sparse scatter —
-            # the dense [D] delta never exists. (do_topk_down is moot
-            # here: every sparse-apply mode has dense_delta=False — the
-            # candidates are already <= k pairs.)
-            scope = ("sketch_decode_sharded" if sharded_decode
-                     else "sparse_aggregate_decode")
-            with jax.named_scope(scope):
-                g_idx, g_val, new_m, new_e, new_comp = decode_mapped(
-                    state.momentum, state.error, state.comp, agg, lr,
-                    state.step,
-                )
-        else:
-            # dense decode (legacy path): the compressor returns the
-            # APPLIED delta (w -= delta), full-[D] on every chip. The
-            # named_scope is an HLO marker like telemetry_diag's: its
-            # absence from the compiled sharded round proves this branch
-            # was never traced (tests/test_sketch_decode.py).
-            with jax.named_scope("server_decode_dense"):
-                delta, new_m, new_e, new_comp = comp.server_update(
-                    state.momentum, state.error, state.comp, agg, lr,
-                    state.step,
-                )
-            if cfg.do_topk_down and comp.dense_delta:
-                # downlink compression (reference down-compression flag):
-                # the broadcast weight delta is itself top-k sparsified, so
-                # the download really is 2k floats (bytes_per_round
-                # accounting). Lossy by design, as in the reference —
-                # coordinates dropped here are NOT re-banked into client
-                # error. Skipped for compressors whose delta is already
-                # compressed (sketch/true_topk: <= k nonzeros; powersgd:
-                # rank-r factored — a full-[D] selection there would be a
-                # pure waste).
-                delta = comp.topk(delta, cfg.k)
-        if use_fedsim:
-            # all-clients-dropped guard: nothing arrived, so nothing may
-            # move — params freeze (the dense delta, or the sharded
-            # candidate VALUES whose scatter then adds 0.0, zero out) and
-            # every server-state leaf (momentum/error/compressor-private)
-            # carries forward; the host-side fedsim/all_dropped sentinel
-            # rides the metrics instead of a 0/0 poisoning the run
-            ok = live_count > 0
-
-            def keep(new, old):
-                return jax.tree.map(lambda n, o: jnp.where(ok, n, o),
-                                    new, old)
-
-            if sparse_apply:
-                g_val = jnp.where(ok, g_val, 0.0)
-            else:
-                delta = jnp.where(ok, delta, 0.0)
-            new_m = keep(new_m, state.momentum)
-            new_e = keep(new_e, state.error)
-            new_comp = keep(new_comp, state.comp)
-        new_params = (
-            state.params_vec.at[g_idx].add(-g_val)
-            if sparse_apply
-            else state.params_vec - delta
+        # renorm + the compressor's momentum/error algebra + the
+        # all-dropped guard + metrics assembly, shared with the asyncfed
+        # apply program via server_phase so the semantics cannot drift
+        # between decodes or engines. count=None (non-fedsim) is a
+        # python-level gate: no renorm/guard ops are traced at all.
+        new_params, new_m, new_e, new_comp, metrics = server_phase(
+            cfg, comp, plan, decode_mapped, state, agg, loss, aux, lr,
+            count=live_count if use_fedsim else None,
+            client_err_rows=new_err,
         )
-        metrics = {"loss": loss, **aux}
-        if cfg.telemetry_level >= 1:
-            # in-graph health diagnostics (telemetry/diagnostics.py): ride
-            # the metrics dict -> the deferred drain path, no extra
-            # fences. The gate is python-level at trace time, so level 0
-            # traces NOTHING here (bit-identical round; HLO smoke test).
-            with jax.named_scope("telemetry_diag"):
-                common = dict(
-                    agg=agg, new_params=new_params, loss=loss, lr=lr,
-                    momentum=state.momentum, error=state.error,
-                    extra=state.comp, new_error=new_e,
-                )
-                metrics.update(
-                    round_diagnostics_sparse(
-                        cfg, comp, idx=g_idx, val=g_val, **common
-                    )
-                    if sparse_apply
-                    else round_diagnostics(
-                        cfg, comp, delta=delta,
-                        client_err_rows=(
-                            new_err if cfg.error_type == "local" else None
-                        ),
-                        **common,
-                    )
-                )
         if cfg.offload_client_state:
             new_state = FedState(
                 new_params, new_m, new_e, (), (), state.step + 1, new_comp

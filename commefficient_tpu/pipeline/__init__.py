@@ -17,6 +17,10 @@ result — every rng stream in this repo is a pure function of
     window and the determinism contracts (controller barrier order,
     policy-lag rule, checkpoint fencing, crash draining); see its module
     docstring.
+  * ``cohorts``: ``CohortScheduler`` — the same prefetcher with the step
+    axis reinterpreted as the asyncfed cohort index (launch-version lr,
+    always host-batch staging); the buffered-asynchronous engine
+    (asyncfed/) keeps C cohorts staged ahead through it.
   * ``scan_engine``: ``ScanRounds`` — the orthogonal dispatch-side
     amortization (``--scan_rounds K``): K rounds per XLA dispatch via
     ``lax.scan`` over the device-resident index round, sampler indices
@@ -35,6 +39,7 @@ and is imported only by ``train/`` and bench — nothing below it knows the
 pipeline exists.
 """
 
+from commefficient_tpu.pipeline.cohorts import CohortScheduler
 from commefficient_tpu.pipeline.engine import PipelinedRounds
 from commefficient_tpu.pipeline.prefetch import (
     PrefetchWorkerDied,
@@ -44,6 +49,7 @@ from commefficient_tpu.pipeline.prefetch import (
 from commefficient_tpu.pipeline.scan_engine import ScanRounds
 
 __all__ = [
+    "CohortScheduler",
     "PipelinedRounds",
     "PrefetchWorkerDied",
     "RoundPrefetcher",

@@ -1,0 +1,73 @@
+"""CohortScheduler — the asyncfed cohort feed on the PR 9 prefetcher.
+
+The buffered-asynchronous engine (asyncfed/engine.py) launches cohorts,
+not rounds, and a cohort's host work is exactly a round's: sample the
+participants, assemble the batch, realize the fedsim environment, stage
+the arrays onto the mesh. So the scheduler IS a ``RoundPrefetcher`` with
+the step axis reinterpreted as the cohort index — the same worker thread,
+in-order ``get`` contract, crash propagation, and replay-horizon
+discipline, with two cohort-specific twists:
+
+* the learning rate is ``lr_fn(launch_version[cohort])``, the server
+  version the cohort snapshots at launch (NOT the cohort index — under
+  concurrency C > 1 a cohort's launch version lags its index);
+* staging always takes the host-batch path (``use_indices=False``): the
+  launch program consumes staged batches regardless of
+  ``cfg.device_data`` (the apply side is where the round's state lives).
+
+Keeping ``C`` (the engine passes ``depth >= C``) cohorts staged ahead is
+what lets the engine keep C cohorts in flight with zero host work on the
+critical path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from commefficient_tpu.pipeline.prefetch import RoundPrefetcher, RoundWork
+
+
+class CohortScheduler:
+    """In-order cohort realization for the asyncfed engine."""
+
+    def __init__(self, *, session, sampler, lr_fn,
+                 launch_versions: Sequence[int], start_cohort: int = 0,
+                 stop_cohort: int, depth: int, microbatches: int = 0,
+                 spans=None, replay_until: int = 0):
+        versions = tuple(int(v) for v in launch_versions)
+
+        def cohort_lr(c: int) -> float:
+            return float(lr_fn(versions[c]))
+
+        self._prefetcher = RoundPrefetcher(
+            session=session,
+            sampler=sampler,
+            lr_fn=cohort_lr,
+            depth=max(1, int(depth)),
+            start_step=int(start_cohort),
+            stop_step=int(stop_cohort),
+            microbatches=microbatches,
+            use_indices=False,
+            spans=spans,
+            replay_until=int(replay_until),
+        )
+
+    def start(self) -> "CohortScheduler":
+        self._prefetcher.start()
+        return self
+
+    def get(self, cohort: int) -> RoundWork:
+        """Blocking in-order fetch of cohort ``cohort``'s realized work
+        (``RoundWork`` with ``step`` == the cohort index)."""
+        return self._prefetcher.get(cohort)
+
+    @property
+    def staged_cohorts(self) -> int:
+        return self._prefetcher.staged_rounds
+
+    @property
+    def prefetch_host_ms(self) -> float:
+        return getattr(self._prefetcher, "host_ms", 0.0)
+
+    def close(self, timeout: Optional[float] = 10.0) -> None:
+        self._prefetcher.close(timeout)

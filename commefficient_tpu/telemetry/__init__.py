@@ -120,7 +120,16 @@ from commefficient_tpu.telemetry.xla_audit import (
 # pair-exchange ceiling — a reduce-scatter of [D] stays legal: it moves
 # O(D/W) per link and lands sharded), mirroring the v3 sharded-decode
 # wk_bound invariant.
-SCHEMA_VERSION = 7
+# v8 (buffered-asynchronous federation PR): the async/* scalar namespace
+# (per-update staleness_mean/staleness_max >= 0, integer-valued
+# buffer_fill >= 0 and concurrent_cohorts >= 0, effective_participation
+# >= 0 — all checker-enforced), and perf_report.json's engine gains
+# "async" with a REQUIRED "async" block {buffer >= 1, concurrency >= 1,
+# staleness_exponent >= 0} on async reports (forbidden on synchronous
+# ones). Byte billing is unchanged by design: an async update's ledger
+# row bills the consumed contributions' uploads, so overlapping cohorts'
+# bytes sum exactly to the synchronous ledger under concurrency 1.
+SCHEMA_VERSION = 8
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

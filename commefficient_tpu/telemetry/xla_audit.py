@@ -272,12 +272,17 @@ class CompiledRoundAudit:
                  wk_bound: Optional[int] = None,
                  sparse_agg_bound: Optional[int] = None,
                  tolerance_bytes: Optional[int] = None,
+                 async_info: Optional[dict] = None,
                  hlo_unavailable_reason: Optional[str] = None):
         self.cost = cost
         self.memory = memory
         self.engine = engine
         self.mode = mode
         self.sketch_decode = sketch_decode
+        # buffered-async audits (engine == "async") carry the overlap
+        # geometry {buffer, concurrency, staleness_exponent}; None on
+        # synchronous rounds (the v8 schema forbids the block there)
+        self.async_info = dict(async_info) if async_info else None
         # resolved --aggregate path (None when the compressor has no sparse
         # aggregation capability): 'sparse' arms the checker's no-O(D)
         # all-reduce/all-gather enforcement against sparse_agg_bound
@@ -376,6 +381,8 @@ class CompiledRoundAudit:
             "hlo_unavailable_reason": self.hlo_unavailable_reason,
             "meta": run_metadata(cfg),
         }
+        if self.async_info is not None:
+            rec["async"] = dict(self.async_info)
         if extra:
             rec.update(extra)
         return jsonable_tree(rec)

@@ -235,6 +235,25 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
             ).start(step)
             print(f"pipeline: depth={cfg.pipeline_depth} (host staging + "
                   "H2D overlap device compute; bit-exact vs depth 0)")
+        elif getattr(cfg, "asyncfed_enabled", False):
+            # buffered-asynchronous engine (asyncfed/): each engine step
+            # is one SERVER UPDATE consuming K of the C in-flight cohorts'
+            # contributions, staleness-discounted. Mutually exclusive with
+            # the pipeline/scan engines (Config-validated); built after
+            # the restore like them (the schedule is a pure function of
+            # the config, the window rebuilds at the resumed update).
+            from commefficient_tpu.asyncfed import AsyncFederation
+
+            engine = AsyncFederation(
+                cfg, session, sampler, lr_fn, num_rounds,
+                steps_per_epoch=steps_per_epoch, spans=spans,
+                profiler=profiler,
+            ).start(step)
+            print(f"asyncfed: buffer K={cfg.async_buffer} "
+                  f"concurrency C={cfg.async_concurrency} "
+                  f"staleness_exponent={cfg.staleness_exponent:g} "
+                  "(K=W, C=1, exponent 0 == the synchronous round, "
+                  "bit-exact)")
         if resil is not None:
             # seed the rollback vault at the start round (post-restore): a
             # divergence before the first snapshot_every boundary is then
@@ -322,13 +341,19 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
                             with span("snapshot"):
                                 # the epoch accumulator rides the snapshot
                                 # (host copy) so a rollback here can
-                                # re-seed it for the replayed tail
-                                resil.snapshot(
-                                    step,
-                                    extras=({"acc": dict(acc_state)}
-                                            if isinstance(acc_state, dict)
-                                            else None),
-                                )
+                                # re-seed it for the replayed tail; the
+                                # asyncfed engine adds its in-flight
+                                # window so the rolled-back replay reuses
+                                # the SAME launched contributions
+                                # (bit-identical recovery at any C)
+                                extras = ({"acc": dict(acc_state)}
+                                          if isinstance(acc_state, dict)
+                                          else {})
+                                if hasattr(engine, "snapshot_extra"):
+                                    extras["asyncfed"] = (
+                                        engine.snapshot_extra()
+                                    )
+                                resil.snapshot(step, extras=extras or None)
                         if (resil is not None
                                 and resil.preempt_requested(metrics)):
                             # preemption-safe shutdown at round
@@ -405,6 +430,13 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
                         # the next boundary resumes without the fork
                         checkpointer.resave(session, step)
                 if engine is not None:
+                    if hasattr(engine, "restore_extra"):
+                        # hand the snapshot's in-flight window back before
+                        # the restart rebuilds it (asyncfed: pending
+                        # launches restore verbatim -> bit-identical
+                        # replay; absent/None -> deterministic cold
+                        # rebuild at the rollback point)
+                        engine.restore_extra(extras.get("asyncfed"))
                     engine.restart(step)  # quiesce + restage the window
                 m = resil.manager
                 print(f"resilience: recovered from divergence at round "

@@ -22,7 +22,7 @@ MODES = ("uncompressed", "sketch", "true_topk", "local_topk", "fedavg",
 ERROR_TYPES = ("none", "local", "virtual")
 # mirrors the fedsim/ availability registry (fedsim.available_models);
 # pinned equal by tests/test_fedsim.py — same no-cycle pattern as MODES
-AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "sine")
+AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "poisson", "sine")
 # mirrors the control/ policy registry (control.CONTROL_POLICIES); pinned
 # equal by tests/test_control.py — same no-cycle pattern as MODES
 CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
@@ -344,6 +344,12 @@ class Config:
     dropout_prob: float = 0.0
     availability_period: int = 64  # sine period (rounds per diurnal cycle)
     num_cohorts: int = 4  # cohort model: slot i belongs to cohort i % n
+    # poisson model: per-client arrival rate (1 / mean exponential delay,
+    # in round-deadline units) — marginal participation probability is
+    # 1 - exp(-rate), and rate=inf degenerates to "always" (delay 0).
+    # Also paces the asyncfed/ continuous-time cohort arrival schedule
+    # (asyncfed/schedule.py draws per-cohort delays at this rate).
+    arrival_rate: float = 1.0
     # Scheduled chaos plan (fedsim/faults.py grammar): comma-separated
     # "kind@value[:rounds=A-B]" with kinds dropout (extra iid dropout),
     # straggler (deadline miss: excluded from aggregation + ledger live
@@ -384,6 +390,31 @@ class Config:
     # mutually exclusive with the control plane, pipeline_depth and
     # preemption sources (validated at construction / train entry).
     scan_rounds: int = 0
+
+    # --- buffered-asynchronous federation (commefficient_tpu/asyncfed/;
+    # FedBuff-style — the reference's round is a synchronous barrier
+    # over num_workers) ---
+    # K: the server applies an update once K of the in-flight cohorts'
+    # contributions have arrived. 0 (default): synchronous rounds —
+    # NOTHING asyncfed-related is constructed and the round stays
+    # bit-identical to a pre-asyncfed build (the telemetry_level-0 /
+    # pipeline_depth-0 discipline). The correctness anchor:
+    # async_buffer=num_workers with async_concurrency=1 and
+    # staleness_exponent=0 reduces BIT-IDENTICALLY to the synchronous
+    # round across every mode/error-type/fedsim combination
+    # (tests/test_asyncfed.py pins it).
+    async_buffer: int = 0
+    # C: cohorts kept in flight concurrently. Each cohort is a full
+    # W-slot launch against the server params AT ITS LAUNCH VERSION;
+    # contributions from different cohorts interleave in the arrival
+    # buffer. 1 = at most one cohort outstanding (still async when
+    # async_buffer < num_workers: updates fire on partial cohorts).
+    async_concurrency: int = 1
+    # alpha: each arriving contribution is weighted by the polynomial
+    # staleness discount (1 + s)^-alpha, where s = server versions
+    # advanced since the contribution's cohort launched (FedBuff/
+    # FedAsync-style). 0 = no discount (pure live-mask weighting).
+    staleness_exponent: float = 0.0
 
     # --- adaptive communication budget (commefficient_tpu/control/;
     # TPU-native — the reference fixes k/num_cols/rank once per run) ---
@@ -665,6 +696,11 @@ class Config:
             raise ValueError(
                 f"num_cohorts must be >= 1, got {self.num_cohorts}"
             )
+        if not self.arrival_rate > 0:  # rejects 0, negatives, and NaN
+            raise ValueError(
+                f"arrival_rate must be > 0 (rate=inf is the degenerate "
+                f"everyone-arrives-instantly case), got {self.arrival_rate}"
+            )
         if self.chaos:
             # syntax + range validation (ValueError with the grammar);
             # lazy import keeps the no-cycle layering (fedsim never
@@ -699,6 +735,7 @@ class Config:
                 f"pipeline_depth must be >= 0 (0 = synchronous), got "
                 f"{self.pipeline_depth}"
             )
+        self._validate_asyncfed()
         self._validate_control()
         self._validate_resilience()
 
@@ -793,6 +830,86 @@ class Config:
                 "the device state only exists at block boundaries, so a "
                 "mid-block preempt would checkpoint the wrong round — "
                 "disable preempt_signals / the preempt@ chaos event"
+            )
+
+    def _validate_asyncfed(self) -> None:
+        """Buffered-asynchronous federation flags (asyncfed/). The async
+        engine launches overlapping per-client cohorts and applies a
+        staleness-weighted update once K contributions arrive, so anything
+        that assumes one cohort per server version — or that removes the
+        per-client transmit rows the launch program ships — is refused
+        here at construction instead of at first trace (the
+        _validate_scan_rounds discipline)."""
+        if self.async_buffer < 0:
+            raise ValueError(
+                f"async_buffer must be >= 0 (0 = synchronous barrier "
+                f"rounds), got {self.async_buffer}"
+            )
+        if self.async_concurrency < 1:
+            raise ValueError(
+                f"async_concurrency must be >= 1, got "
+                f"{self.async_concurrency}"
+            )
+        if self.staleness_exponent < 0:
+            raise ValueError(
+                f"staleness_exponent must be >= 0 ((1+s)^-alpha is a "
+                f"DISCOUNT; a negative alpha would amplify stale "
+                f"contributions), got {self.staleness_exponent}"
+            )
+        if self.async_buffer == 0:
+            if self.async_concurrency != 1:
+                raise ValueError(
+                    "async_concurrency > 1 has no effect without "
+                    "--async_buffer K; set async_buffer > 0 to enable the "
+                    "asyncfed engine"
+                )
+            if self.staleness_exponent != 0.0:
+                raise ValueError(
+                    "staleness_exponent has no effect without "
+                    "--async_buffer K: synchronous rounds have staleness 0 "
+                    "by construction"
+                )
+            return
+        if self.async_buffer > self.num_workers:
+            raise ValueError(
+                f"async_buffer must be <= num_workers ("
+                f"{self.num_workers}): an update consumes at most one full "
+                f"cohort's W slots per in-flight cohort, and K > W would "
+                f"just wait for the next cohort anyway — raise "
+                f"async_concurrency instead, got {self.async_buffer}"
+            )
+        if self.fuse_clients or self.sketch_fused_bwd:
+            raise ValueError(
+                "async_buffer > 0 needs PER-CLIENT transmit rows (each "
+                "arrival is weighted by its own staleness/live factor); "
+                "the fused flattened-batch paths produce one device-level "
+                "gradient — drop fuse_clients/sketch_fused_bwd"
+            )
+        if self.offload_client_state or self.fsdp:
+            raise ValueError(
+                "async_buffer > 0 currently requires HBM-resident client "
+                "state on the replicated engine "
+                "(offload_client_state/fsdp run their own round builders)"
+            )
+        if self.scan_rounds > 1:
+            raise ValueError(
+                "async_buffer > 0 is mutually exclusive with "
+                "scan_rounds > 1: a scanned block admits no host-side "
+                "arrival buffering between its rounds"
+            )
+        if self.pipeline_depth > 0:
+            raise ValueError(
+                "async_buffer > 0 supersedes pipeline_depth: the asyncfed "
+                "engine owns its own cohort prefetch window "
+                "(async_concurrency cohorts in flight) — drop "
+                "pipeline_depth"
+            )
+        if self.preempt_signals or "preempt@" in self.chaos:
+            raise ValueError(
+                "async_buffer > 0 cannot yet honor round-granular "
+                "preemption: in-flight cohorts would be abandoned "
+                "mid-arrival — disable preempt_signals / the preempt@ "
+                "chaos event"
             )
 
     def _validate_resilience(self) -> None:
@@ -998,6 +1115,14 @@ class Config:
         path with nothing pipeline-related constructed — the
         fedsim_enabled/control_enabled discipline."""
         return self.pipeline_depth > 0
+
+    @property
+    def asyncfed_enabled(self) -> bool:
+        """True when the buffered-asynchronous engine must be built
+        (asyncfed/ package). False keeps the train loop on the synchronous
+        engines with nothing asyncfed-related constructed — the
+        fedsim_enabled/pipeline_enabled gate discipline."""
+        return self.async_buffer > 0
 
     @property
     def sampler_batch_size(self) -> int:

@@ -22,6 +22,8 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main():
+    from commefficient_tpu.utils.config import AVAILABILITY_MODELS
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--num_epochs", type=int, default=8)
     ap.add_argument("--dataset_dir", default="./data")
@@ -72,6 +74,25 @@ def main():
                          "participation but switches to the same fleet "
                          "accounting). Omit the flag entirely for the "
                          "classic per-client table.")
+    ap.add_argument("--availability", default=None,
+                    choices=sorted(AVAILABILITY_MODELS),
+                    help="fedsim availability model for EVERY run (was "
+                         "hardwired to bernoulli whenever --dropout was "
+                         "given). --dropout still sets the decline "
+                         "probability; the model-specific knobs below "
+                         "shape who arrives. Passing --availability alone "
+                         "(no --dropout) enables the environment at "
+                         "dropout 0 in fleet byte units.")
+    ap.add_argument("--arrival_rate", type=float, default=1.0,
+                    help="poisson model: exponential arrival rate in "
+                         "round-deadline units (participation 1-exp(-rate)"
+                         "; inf = everyone instant). Also paces the "
+                         "asyncfed cohort schedule when --async_buffer "
+                         "style runs adopt this table's configs.")
+    ap.add_argument("--availability_period", type=int, default=64,
+                    help="sine model: rounds per diurnal cycle")
+    ap.add_argument("--num_cohorts", type=int, default=4,
+                    help="cohort model: number of correlated-outage groups")
     args = ap.parse_args()
 
     from commefficient_tpu.control import BudgetExhaustedError
@@ -97,13 +118,20 @@ def main():
         # perf numbers
         perf_audit=False,
     )
-    if args.dropout is not None:
+    if args.dropout is not None or args.availability is not None:
         # fedsim partial participation for the whole table (masking forces
         # the per-client vmap path; fuse_clients flags below are ignored).
         # An EXPLICIT --dropout 0.0 still enables the environment so the
         # ledger uses the same fleet live-byte units as the lossy runs —
         # that is what makes the 0%-vs-30% loss-vs-bytes comparison valid.
-        base.update(availability="bernoulli", dropout_prob=args.dropout)
+        # --availability picks the model (bernoulli stays the --dropout
+        # shorthand default) and the model knobs ride along; Config
+        # validation rejects nonsensical combinations.
+        base.update(availability=args.availability or "bernoulli",
+                    dropout_prob=args.dropout or 0.0,
+                    arrival_rate=args.arrival_rate,
+                    availability_period=args.availability_period,
+                    num_cohorts=args.num_cohorts)
     if args.budget_mb is not None:
         # the control plane enforces the cap (controller accounting ==
         # ledger accounting exactly); no ladder -> a single implicit rung,

@@ -82,6 +82,11 @@ TOLERANCES = {
     # tight ratio band, same reasoning as the gpt2 ratios above
     "local_topk_sparse_agg_vs_dense": 0.10,
     "true_topk_sparse_agg_vs_dense": 0.10,
+    # asyncfed PR: the update-rate ratio divides two same-mesh
+    # measurements (tight band); the time-to-loss ratio folds in the loss
+    # trajectory under a stochastic straggler schedule, so it keeps the
+    # default wider band (no entry)
+    "sketch_async_vs_sync": 0.10,
 }
 
 # pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated
@@ -93,7 +98,13 @@ TOLERANCES = {
 LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
 HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
 HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
-                             "_samples_per_sec", "_occupancy", "_vs_dense")
+                             "_samples_per_sec", "_occupancy", "_vs_dense",
+                             # asyncfed PR: both twins' server-update rates
+                             # and the async/sync ratios gate up
+                             # (*_time_to_loss_sec itself stays
+                             # informational — its ratio carries the gate)
+                             "_updates_per_sec", "_rounds_per_sec",
+                             "_vs_sync")
 # resilience/control PRs: every *_retraces leg gauge is a hard invariant,
 # not a throughput — the AOT-prewarm contract says rung switches and
 # rollback restores never retrace, so ANY non-zero value fails outright

@@ -62,14 +62,19 @@ from pathlib import Path
 # field + collectives "sparse_agg_bound"/"max_all_reduce_elems" — on
 # aggregate == 'sparse' NO single all-reduce or all-gather may move more
 # elements than sparse_agg_bound (enforced below; reduce-scatter is
-# exempt by design: O(D/W) per link, sharded result). Older artifacts
-# stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+# exempt by design: O(D/W) per link, sharded result); v8 (buffered-
+# asynchronous federation PR): async/* scalar namespace (staleness_mean/
+# staleness_max >= 0, integer buffer_fill >= 0 and concurrent_cohorts
+# >= 0, effective_participation >= 0 — enforced below), perf_report
+# engine "async" with a REQUIRED {buffer, concurrency,
+# staleness_exponent} "async" block on async reports and the block
+# FORBIDDEN on synchronous ones. Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
-                   "control/", "pipeline/", "resilience/")
+                   "control/", "pipeline/", "resilience/", "async/")
 
 
 class SchemaError(ValueError):
@@ -247,6 +252,40 @@ def _check_resilience_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_async_scalar(name: str, v, where: str) -> None:
+    """v8 ``async/*`` value invariants. Host-computed overlap gauges
+    (asyncfed/engine.py), never legitimately non-finite: staleness is a
+    server-version delta (>= 0 by construction); ``buffer_fill`` counts
+    delivered-unconsumed contributions (non-negative integer);
+    ``concurrent_cohorts`` counts in-flight cohorts after the top-up
+    (non-negative integer; 0 only on trailing updates, where the
+    schedule stops relaunching); ``effective_participation`` is the
+    update's weight sum (>= 0; < K under staleness discounting)."""
+    if not name.startswith("async/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name in ("async/staleness_mean", "async/staleness_max",
+                "async/effective_participation") and v < 0:
+        raise SchemaError(
+            f"{where}: {name} {v} is negative — staleness is a server-"
+            "version delta and participation a weight sum, both >= 0"
+        )
+    if name == "async/buffer_fill" and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: async/buffer_fill {v} is not a non-negative "
+            "integer — it counts delivered-unconsumed contributions"
+        )
+    if name == "async/concurrent_cohorts" and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: async/concurrent_cohorts {v} is not a non-negative "
+            "integer — it counts whole in-flight cohorts"
+        )
+
+
 def _check_recovery_history(hist, where: str) -> None:
     """v6 flight ``recovery_history`` block: one entry per divergence
     rollback, in recovery order."""
@@ -312,6 +351,7 @@ def validate_metrics_jsonl(path) -> int:
             _check_scalar_value(rec["value"], name, where)
             _check_pipeline_scalar(name, rec["value"], where)
             _check_resilience_scalar(name, rec["value"], where)
+            _check_async_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
@@ -496,6 +536,7 @@ def validate_flight(path) -> dict:
             _check_scalar_value(v, name, w)
             _check_pipeline_scalar(name, v, w)
             _check_resilience_scalar(name, v, w)
+            _check_async_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step
@@ -539,9 +580,31 @@ def validate_perf_report(path) -> dict:
                           f"{rec.get('kind')!r}")
     _req(rec, "generated_by", str, where)
     engine = _req(rec, "engine", str, where)
-    if engine not in ("replicated", "fsdp"):
+    if engine not in ("replicated", "fsdp", "async"):
         raise SchemaError(f"{where}: unknown engine {engine!r}")
     _req(rec, "mode", str, where)
+    # v8: the overlap-geometry block is required exactly on async audits —
+    # a synchronous report carrying one means the producer mislabeled the
+    # engine (or vice versa), so both directions are hard errors
+    if engine == "async":
+        blk = _req(rec, "async", dict, where)
+        for f, lo in (("buffer", 1), ("concurrency", 1),
+                      ("staleness_exponent", 0)):
+            v = blk.get(f)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise SchemaError(
+                    f"{where}:async: missing or non-numeric {f!r}"
+                )
+            if f != "staleness_exponent" and v != int(v):
+                raise SchemaError(f"{where}:async: {f} must be an integer, "
+                                  f"got {v!r}")
+            if v < lo:
+                raise SchemaError(f"{where}:async: {f} {v} below {lo}")
+    elif "async" in rec:
+        raise SchemaError(
+            f"{where}: 'async' block present on a {engine!r} report — the "
+            "overlap geometry is an async-engine property (schema v8)"
+        )
     _check_header({**_req(rec, "meta", dict, where),
                    "schema_version": rec["schema_version"]}, where + ":meta")
     cost = _req(rec, "cost", dict, where)

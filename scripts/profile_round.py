@@ -384,6 +384,64 @@ def main():
                   + (" (peak assumed)" if assumed else ""))
     except Exception as e:  # noqa: BLE001 — the audit must not kill the lab
         print(f"[audited] compiled-round audit unavailable: {e}")
+    # -- asyncfed phase lines (buffered-async PR) --------------------------
+    # the engine's round splits into cohort LAUNCH (one cohort's W
+    # per-client grads + encode — device work paid once per cohort, then
+    # amortized over ceil(W/K) server updates), ARRIVAL (the host-side
+    # continuous-time schedule simulation + per-update slot bookkeeping —
+    # the only work the buffered-async layer adds on the critical path),
+    # and APPLY (the staleness-weighted K-row server update). These lines
+    # dispatch THE compiled pair the engine itself reuses
+    # (session.async_round_fns), so the split reconciles against
+    # AsyncFederation's async_launch/async_apply spans.
+    if args.mode == "sketch":
+        try:
+            from commefficient_tpu.asyncfed import AsyncSchedule
+
+            K, C = workers // 2, 2
+            acfg = cfg.replace(fuse_clients=False, async_buffer=K,
+                               async_concurrency=C, staleness_exponent=0.5)
+            asess = FederatedSession(acfg, params, loss_fn, mesh=make_mesh(1))
+            launch_fn, apply_fn = asess.async_round_fns()
+            ast = asess.state
+            t0 = time.perf_counter()
+            for _ in range(r):
+                sch = AsyncSchedule(seed=acfg.seed, num_workers=workers,
+                                    buffer_k=K, concurrency=C,
+                                    arrival_rate=1.0, num_updates=50)
+            dt_arr = (time.perf_counter() - t0) / r * 1e3
+            print(f"[async arrival] 50-update host schedule (K={K}, C={C}): "
+                  f"{dt_arr:.2f} ms ({dt_arr / 50 * 1e3:.0f} us/update)")
+            launch_j = lambda: launch_fn(  # noqa: E731
+                ast.params_vec, ast.client_vel, ast.client_err, ids, data,
+                jnp.int32(0), jnp.float32(0.1))
+            out = launch_j()
+            fence(out[3])
+            t0 = time.perf_counter()
+            for _ in range(r):
+                out = launch_j()
+            fence(out[3])
+            dt_l = (time.perf_counter() - t0) / r * 1e3
+            print(f"[async launch] cohort W={workers} grads+encode: "
+                  f"{dt_l:.2f} ms")
+            weights = jnp.ones((workers,), jnp.float32)
+            # donated first arg: thread the returned state back through
+            ast, m = apply_fn(ast, *out, ids, weights,
+                              jnp.float32(workers), jnp.float32(0.1))
+            fence(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(r):
+                ast, m = apply_fn(ast, *out, ids, weights,
+                                  jnp.float32(workers), jnp.float32(0.1))
+            fence(m["loss"])
+            dt_a = (time.perf_counter() - t0) / r * 1e3
+            print(f"[async apply] staleness-weighted {workers}-row server "
+                  f"update: {dt_a:.2f} ms (launch amortized over "
+                  f"~{-(-workers // K)} updates -> "
+                  f"{dt_l / -(-workers // K) + dt_a:.2f} ms/update)")
+        except Exception as e:  # noqa: BLE001 — lab line, never kills the run
+            print(f"[async] phase lines unavailable: {e}")
+
     round_fn = session.round_fn
     n = 10
 

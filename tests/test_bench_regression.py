@@ -271,3 +271,50 @@ def test_json_summary_always_last_line(tmp_path, capsys):
     assert mod.main(["--max-new-metrics", "0"]) == 2
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "argument parsing failed" in summary["error"]
+
+
+def test_async_metrics_registered_and_gated(tmp_path):
+    """asyncfed PR: the buffered-async twin legs gate on their update
+    rates and _vs_sync ratios (higher is better; the update-rate ratio
+    carries the tight 10% band — twin runs of one geometry, load
+    cancels), the retrace gauge is hard-zero, and the geometry/provenance
+    rows stay informational."""
+    mod = _gate()
+    for name in ("sketch_async_updates_per_sec",
+                 "sketch_async_sync_rounds_per_sec",
+                 "sketch_async_vs_sync",
+                 "sketch_async_time_to_loss_vs_sync"):
+        assert mod.metric_direction(name) == "up"
+    assert mod.tolerance_for("sketch_async_vs_sync", 0.15) == 0.10
+    # time-to-loss folds in a stochastic straggler schedule — default band
+    assert mod.tolerance_for("sketch_async_time_to_loss_vs_sync",
+                             0.15) == 0.15
+    for name in ("sketch_async_buffer", "sketch_async_concurrency",
+                 "sketch_async_straggler_rate",
+                 "sketch_async_time_to_loss_sec", "sketch_async_error"):
+        assert mod.metric_direction(name) is None
+    # detects-regression self-test: the async advantage collapsing
+    # (1.5x -> 1.0x) past the band must gate and name the ratio
+    good = {**BASELINE, "sketch_async_vs_sync": 1.5,
+            "sketch_async_updates_per_sec": 3.0,
+            "sketch_async_retraces": 0}
+    bad = {**BASELINE, "sketch_async_vs_sync": 1.0,
+           "sketch_async_updates_per_sec": 1.9,
+           "sketch_async_retraces": 0}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", bad)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _, _ = mod.check_regression([good], bad)
+    names = {r["metric"] for r in regs}
+    assert names == {"sketch_async_vs_sync",
+                     "sketch_async_updates_per_sec"}
+    # within the band passes
+    regs, _, _ = mod.check_regression(
+        [good], {**good, "sketch_async_vs_sync": 1.42})
+    assert regs == []
+    # a retrace at ANY concurrency fails outright (the one-compiled-pair-
+    # per-rung contract)
+    regs, _, _ = mod.check_regression(
+        [good], {**good, "sketch_async_retraces": 1})
+    assert [r["metric"] for r in regs] == ["sketch_async_retraces"]
+    assert regs[0]["direction"] == "exact_zero"

@@ -95,6 +95,55 @@ def test_always_and_sine_and_cohort_shapes():
             assert len({float(v) for v in live[c::4]}) == 1
 
 
+def test_poisson_registered_and_rate_inf_is_always():
+    """poisson (the asyncfed arrival model's round-granular projection) is
+    a first-class availability model; rate -> inf means delay 0, so with
+    no decline knob every slot makes every round — exactly ``always``."""
+    assert "poisson" in AVAILABILITY_MODELS
+    env = _env(availability="poisson", dropout_prob=0.0,
+               arrival_rate=float("inf"))
+    always = _env(availability="always", dropout_prob=0.0)
+    for r in range(20):
+        np.testing.assert_array_equal(env.round_env(r).live,
+                                      always.round_env(r).live)
+        assert env.round_env(r).live_count == 8.0
+
+
+def test_poisson_marginal_participation_tracks_rate():
+    """Realized participation over many rounds approaches 1 - exp(-rate)
+    (each slot arrives iff its exponential delay fits one deadline)."""
+    rate = 2.0
+    env = _env(availability="poisson", dropout_prob=0.0, arrival_rate=rate)
+    live = np.concatenate([env.round_env(r).live for r in range(200)])
+    assert abs(live.mean() - (1.0 - np.exp(-rate))) < 0.03
+
+
+def test_poisson_dropout_composes_and_rng_cursor_is_knob_independent():
+    """dropout_prob composes (reachable-yet-declining clients), and the
+    arrival-rate knob cannot shift the shared round rng's cursor: at
+    rate=inf every arrival succeeds, so the only masking left is the
+    decline draw — which must realize IDENTICALLY across rates' streams."""
+    a = _env(availability="poisson", dropout_prob=0.4,
+             arrival_rate=float("inf"))
+    b = _env(availability="poisson", dropout_prob=0.4, arrival_rate=50.0)
+    declines_seen = False
+    for r in range(30):
+        la = a.round_env(r).live
+        # rate=50 arrivals virtually always make the deadline; any miss can
+        # only REMOVE clients relative to the rate=inf mask, never add
+        lb = b.round_env(r).live
+        assert not np.any(lb > la)
+        declines_seen = declines_seen or la.sum() < 8
+    assert declines_seen, "dropout_prob=0.4 must realize some declines"
+
+
+def test_poisson_rejects_bad_arrival_rate():
+    for bad in (0.0, -1.0, float("nan")):
+        with pytest.raises(ValueError, match="arrival_rate"):
+            Config(num_workers=8, num_clients=16, availability="poisson",
+                   arrival_rate=bad)
+
+
 # ---------------------------------------------------------------------------
 # chaos plans
 # ---------------------------------------------------------------------------

@@ -516,3 +516,127 @@ def test_v6_flight_recovery_history_validates_and_rejects(tmp_path):
     tampered(lambda r: r.update(recovery_history=[]), "non-empty")
     tampered(lambda r: r["recovery_history"][0].update(first_bad_step=-1),
              "negative first_bad_step")
+
+
+# ---------------------------------------------------------------------------
+# v8: async/* scalars + the perf_report overlap-geometry block
+# ---------------------------------------------------------------------------
+
+def test_v8_async_scalars_validate_and_reject(tmp_path):
+    """The async/ scalar prefix is in-schema through the REAL writer; the
+    staleness-sign and integer-gauge invariants are enforced (tampered
+    values rejected). The end-to-end form — these scalars riding a real
+    asyncfed run's metrics.jsonl — is pinned by tests/test_asyncfed.py."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, num_workers=8,
+                 num_devices=8, async_buffer=4, async_concurrency=2,
+                 staleness_exponent=0.5)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("async/staleness_mean", 0.5 * s, s)
+        writer.scalar("async/staleness_max", float(s), s)
+        writer.scalar("async/buffer_fill", float(s), s)
+        # 0 is legal: the run's trailing updates launch no replacement
+        writer.scalar("async/concurrent_cohorts", float(2 - s), s)
+        writer.scalar("async/effective_participation", 3.5, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 21
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "async/staleness_mean", "value": -0.5, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "async/staleness_max", "value": -1.0, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "async/effective_participation", "value": -3.5,
+          "step": 0, "t": 1.0}, "negative"),
+        ({"name": "async/buffer_fill", "value": 1.5, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "async/buffer_fill", "value": -1.0, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "async/concurrent_cohorts", "value": 0.5, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "async/concurrent_cohorts", "value": -1.0, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "async/staleness_mean", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def _write_perf_report(tmp_path, **extra):
+    """A REAL audit-produced perf report on the TinyMLP round (the async
+    variant exercises the engine='async' producer path end-to-end)."""
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from commefficient_tpu.data import FedDataset, FedSampler
+    from commefficient_tpu.models.losses import classification_loss
+    from commefficient_tpu.parallel import FederatedSession
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16)(x))
+            return nn.Dense(4)(x)
+
+    cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+                 k=20, num_rows=3, num_cols=200, telemetry_level=1,
+                 num_clients=12, num_workers=8, num_devices=8,
+                 local_batch_size=4, seed=5, **extra)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=200).astype(np.int32)
+    ds = FedDataset({"x": x, "y": y}, cfg.num_clients, iid=True, seed=0)
+    model = TinyMLP()
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8)))
+    sess = FederatedSession(cfg, params, classification_loss(model.apply))
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.sampler_batch_size, seed=1)
+    ids, batch = sampler.sample_round(0)
+    audit = sess.audit_compiled_round(ids, batch, 0.2)
+    return audit.write(str(tmp_path), generated_by="test", cfg=cfg)
+
+
+def test_v8_perf_report_async_block_required_and_forbidden(tmp_path):
+    """A REAL async audit report validates with its overlap-geometry
+    block; the checker rejects every mislabeling direction — block on a
+    sync report, async engine without a block, and malformed geometry."""
+    mod = _checker()
+    path = _write_perf_report(tmp_path, async_buffer=4, async_concurrency=2,
+                              staleness_exponent=0.5)
+    rec = mod.validate_perf_report(path)
+    assert rec["engine"] == "async"
+    assert rec["async"] == {"buffer": 4, "concurrency": 2,
+                            "staleness_exponent": 0.5}
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_report.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_perf_report(bad)
+
+    tampered(lambda r: r.pop("async"), "missing required field 'async'")
+    tampered(lambda r: r["async"].update(buffer=0), "below 1")
+    tampered(lambda r: r["async"].update(concurrency=1.5),
+             "must be an integer")
+    tampered(lambda r: r["async"].update(staleness_exponent="x"),
+             "non-numeric")
+    tampered(lambda r: r["async"].update(staleness_exponent=-0.5),
+             "below 0")
+    tampered(lambda r: r.update(engine="bogus"), "unknown engine")
+    # forbidden direction: the block riding a synchronous report
+    tampered(lambda r: r.update(engine="replicated"),
+             "present on a 'replicated' report")
