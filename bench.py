@@ -765,6 +765,157 @@ def _measure_async(base, n_updates: int = 8) -> dict:
     }
 
 
+def _measure_overlap(base, n_rounds: int = 10, n_updates: int = 8) -> dict:
+    """Hidden-collectives PR: the two overlap modes vs their sequential
+    twins, on the SAME mesh and round shape (the ratios divide two
+    measurements of the same run, so load cancels — both get the tight
+    band in scripts/check_bench_regression.py and gate UP).
+
+      * sketch_overlap_layerwise_samples_per_sec / _vs_sequential — the
+        fused sketch
+        round with the table psum + candidate pair-gathers chunked into
+        per-leaf-group segments (``--overlap_collectives layerwise``)
+        against the monolithic-collective twin;
+      * async_double_buffered_updates_per_sec / _vs_sequential — the
+        asyncfed engine with the apply fence deferred behind the next
+        cohort's launches (``--async_double_buffer``) against the
+        sequential-fence twin, spans attached to BOTH so the fence
+        discipline (the only thing the double buffer moves) is active;
+        the leg also reports both twins' exposed_collective_ms (the new
+        v9 metric, informational — near-zero ms bands are noise).
+
+    Requires a multi-device host: on one chip there is no cross-chip
+    collective to hide, so both legs report a skip marker instead of a
+    fake 1.0 ratio."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.asyncfed import AsyncFederation
+    from commefficient_tpu.data import FedDataset, FedSampler
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.telemetry import PhaseSpans
+    from commefficient_tpu.utils.profiling import fence
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        reason = (f"single-device host ({n_dev} chip) — no cross-chip "
+                  "collective to hide")
+        return {"sketch_overlap_layerwise_skipped": reason,
+                "async_double_buffered_skipped": reason}
+
+    out: dict = {}
+    B = base.local_batch_size
+    cfg = base.replace(num_devices=n_dev, num_workers=n_dev,
+                       num_clients=2 * n_dev)
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply,
+                                  compute_dtype=cfg.compute_dtype)
+    rng = np.random.default_rng(0)
+
+    # -- leg 1: layerwise-segmented collectives on the fused sketch round
+    try:
+        ids = jnp.asarray(np.arange(n_dev, dtype=np.int32))
+        data = {
+            "x": jnp.asarray(
+                rng.normal(size=(n_dev, B, 32, 32, 3)).astype(np.float32)
+            ),
+            "y": jnp.asarray(
+                rng.integers(0, 10, size=(n_dev, B)).astype(np.int32)
+            ),
+        }
+        sps = {}
+        for ov in ("none", "layerwise"):
+            session = FederatedSession(
+                cfg.replace(overlap_collectives=ov), params, loss_fn,
+                mesh=make_mesh(n_dev),
+            )
+            state, round_fn = session.state, session.round_fn
+            for _ in range(3):  # compile + donated-layout warmup
+                state, m = round_fn(state, ids, data, jnp.float32(0.1))
+                assert np.isfinite(fence(m["loss"]))
+            t0 = time.perf_counter()
+            for _ in range(n_rounds):
+                state, m = round_fn(state, ids, data, jnp.float32(0.1))
+            assert np.isfinite(fence(m["loss"]))
+            sps[ov] = n_rounds * n_dev * B / (time.perf_counter() - t0)
+        out["sketch_overlap_layerwise_samples_per_sec"] = round(
+            sps["layerwise"], 2
+        )
+        out["sketch_overlap_layerwise_vs_sequential"] = round(
+            sps["layerwise"] / sps["none"], 3
+        )
+    except Exception as e:  # noqa: BLE001 — per-leg error isolation
+        out["sketch_overlap_layerwise_error"] = (
+            f"{type(e).__name__}: {e}"[:200]
+        )
+
+    # -- leg 2: double-buffered asyncfed apply fencing
+    try:
+        W = n_dev
+        cfg_a = cfg.replace(
+            fuse_clients=False, device_data=False,
+            async_buffer=W, async_concurrency=1,
+        )
+        n = 4 * W * B
+        ds = FedDataset(
+            {"x": rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.uint8),
+             "y": rng.integers(0, 10, size=(n,)).astype(np.int32)},
+            cfg_a.num_clients, iid=True, seed=0,
+        )
+
+        def run_engine(double_buffer: bool):
+            cfg_run = cfg_a.replace(async_double_buffer=double_buffer)
+            session = FederatedSession(cfg_run, params, loss_fn,
+                                       mesh=make_mesh(n_dev))
+            spans = PhaseSpans(tempfile.mkdtemp(prefix="bench_overlap_"))
+            session.spans = spans
+            sampler = FedSampler(ds, num_workers=W, local_batch_size=B,
+                                 seed=0)
+            total = 2 + n_updates
+            engine = AsyncFederation(cfg_run, session, sampler,
+                                     lambda _s: 0.1, total,
+                                     steps_per_epoch=total,
+                                     spans=spans).start()
+            last = None
+            try:
+                t0 = None
+                for step, _lr, m in engine.epoch_rounds(0, 0):
+                    # no per-update fence: the fence discipline under
+                    # test is the engine's own (spans-armed) one
+                    last = m["loss"]
+                    if step == 1:  # warmup: both compiled layouts done
+                        fence(last)
+                        t0 = time.perf_counter()
+                assert np.isfinite(fence(last))
+                dt = time.perf_counter() - t0
+            finally:
+                engine.close()
+            stall = engine.stats()["host_stall_ms"]
+            return dt, spans.collective_exposure_ms(), stall
+
+        dt_seq, exp_seq, _ = run_engine(False)
+        dt_db, exp_db, stall_db = run_engine(True)
+        out.update({
+            "async_double_buffered_updates_per_sec": round(
+                n_updates / dt_db, 3
+            ),
+            "async_double_buffered_vs_sequential": round(dt_seq / dt_db, 3),
+            "async_double_buffered_exposed_collective_ms": round(exp_db, 3),
+            "async_sequential_exposed_collective_ms": round(exp_seq, 3),
+            "async_double_buffered_host_stall_ms": round(stall_db, 3),
+        })
+    except Exception as e:  # noqa: BLE001
+        out["async_double_buffered_error"] = (
+            f"{type(e).__name__}: {e}"[:200]
+        )
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -894,6 +1045,18 @@ def main():
         else:
             rows.update(asy)
             print(json.dumps({"metric": "sketch_async", **asy}))
+        # hidden-collectives PR: layerwise-segmented collectives and the
+        # double-buffered asyncfed apply vs their sequential twins (skip
+        # markers on a single-device host — nothing cross-chip to hide)
+        try:
+            ovl = _measure_overlap(base)
+        except Exception as e:  # noqa: BLE001
+            rows["sketch_overlap_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "sketch_overlap",
+                              "error": rows["sketch_overlap_error"]}))
+        else:
+            rows.update(ovl)
+            print(json.dumps({"metric": "sketch_overlap", **ovl}))
 
     # pipeline PR: the pipelined-execution leg rides the HEADLINE line
     # (gated by scripts/check_bench_regression.py — occupancy + samples/s

@@ -24,6 +24,15 @@ synchronous run), plus ``async/*`` overlap scalars (staleness mean/max,
 buffer fill, concurrent cohorts, effective participation) that also feed
 the control plane's join inputs.
 
+Double-buffered rounds (``cfg.async_double_buffer``): the apply's host
+fence is deferred until AFTER the next update's cohort launches have
+dispatched (``_drain_deferred``), so update ``u+1``'s compute is already
+queued when the host waits on ``u``'s aggregation collectives — XLA's
+async scheduling then overlaps the two. Strictly a host-side fencing
+change: the device programs and their dispatch order are untouched, so
+the K=W, C=1, alpha=0 synchronous reduction stays bit-identical and the
+vault rollback replay is unaffected (every exit path drains first).
+
 Ladder interplay: a mid-run rung switch (control/) changes which
 ``(launch_fn, apply_fn)`` pair subsequent dispatches use. In-flight rows
 launched under the old rung are dense [D] transmits in every mode, so
@@ -101,6 +110,16 @@ class AsyncFederation:
         self._updates_run = 0
         self._cohorts_launched = 0
         self._host_stall_ms = 0.0
+        # double-buffered rounds (cfg.async_double_buffer): the apply's
+        # host fence is PARKED here and drained only after the NEXT
+        # update's cohort launches have dispatched, so XLA schedules the
+        # apply's collectives behind the new launches' compute instead of
+        # the host serializing on them. Pure host scheduling — dispatch
+        # order of the device programs is unchanged, so the K=W, C=1,
+        # a=0 sync reduction stays bit-identical.
+        self._double_buffer = bool(getattr(cfg, "async_double_buffer",
+                                           False))
+        self._deferred = None
         if session.controller is not None:
             session.controller.add_switch_listener(self._on_rung_switch)
 
@@ -115,6 +134,7 @@ class AsyncFederation:
         """Quiesce and rebuild the window at update ``step`` — the vault
         rollback path (``restore_extra`` first restores the snapshotted
         in-flight window; without one the window cold-restarts)."""
+        self._drain_deferred()
         if self._scheduler is not None:
             self._scheduler.close()
             self._scheduler = None
@@ -127,6 +147,7 @@ class AsyncFederation:
                 pass
 
     def close(self) -> None:
+        self._drain_deferred()
         if self._scheduler is not None:
             self._scheduler.close()
             self._scheduler = None
@@ -180,10 +201,23 @@ class AsyncFederation:
                 self._launch_work(c, work)
 
     # -- launch ------------------------------------------------------------
-    def _span(self, name: str):
-        return self.spans.span(name) if self.spans is not None else (
-            nullcontext()
-        )
+    def _span(self, name: str, collective: bool = False):
+        return self.spans.span(name, collective=collective) if (
+            self.spans is not None) else nullcontext()
+
+    def _drain_deferred(self) -> None:
+        """Fence the PREVIOUS update's parked apply (double-buffer mode).
+        Called after the next update's launches dispatch — the drain span
+        then measures only the collective time the launches failed to
+        hide — and on every path that leaves the steady-state loop
+        (restart/close/snapshot), so the window never rides an unfenced
+        apply into the vault."""
+        if self._deferred is None:
+            return
+        loss, self._deferred = self._deferred, None
+        with self._span("async_apply_drain", collective=True) as sp:
+            if sp is not None:
+                sp.fence(loss)
 
     def _launch_work(self, c: int, work) -> None:
         """Dispatch cohort ``c``'s launch program against the current
@@ -237,6 +271,9 @@ class AsyncFederation:
                 self._launch_work(c, work)
                 self._next_cohort = c + 1
             self._host_stall_ms += stall * 1000.0
+            # double buffer: update step-1's apply fences HERE, after this
+            # update's cohort launches are already in flight on device
+            self._drain_deferred()
             if self.profiler is not None:
                 self.profiler.step(step)
             if self.spans is not None:
@@ -331,7 +368,9 @@ class AsyncFederation:
         # rows are dense transmits, re-encoded under the new rung)
         sess._control_round_start(fs_stats)
         _, apply_fn = sess.async_round_fns(sess.active_rung)
-        with self._span("async_apply") as sp:
+        name = ("async_apply_dispatch" if self._double_buffer
+                else "async_apply")
+        with self._span(name, collective=not self._double_buffer) as sp:
             sess.state, metrics = apply_fn(
                 sess.state, put(rows), put(vel_rows), put(err_rows),
                 put(loss_rows), jax.tree.map(put, aux_rows),
@@ -339,7 +378,12 @@ class AsyncFederation:
                 jnp.float32(wsum), jnp.float32(lr),
             )
             if sp is not None:
-                sp.fence(metrics["loss"])
+                if self._double_buffer:
+                    # park the fence target; _drain_deferred fences it
+                    # after the NEXT update's launches dispatch
+                    self._deferred = metrics["loss"]
+                else:
+                    sp.fence(metrics["loss"])
         # mirror train_round's clock discipline: the availability/chaos
         # schedule and the controller key off the host round clock
         sess._round_clock += 1
@@ -365,6 +409,7 @@ class AsyncFederation:
         restoring it replays the post-rollback tail bit-identically
         (pending outputs are NOT re-launched: the blacklist may have
         grown since, and the rows must be the ones the first pass saw)."""
+        self._drain_deferred()
         pending = {
             int(c): {
                 "out": jax.tree.map(np.asarray, p["out"]),

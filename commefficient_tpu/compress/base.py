@@ -96,6 +96,20 @@ class Compressor:
             self.unsketch = partial(unsketch, approx=approx)
         self._dampen: Optional[bool] = None
 
+    @property
+    def overlap_segments(self) -> Optional[int]:
+        """``None`` (monolithic collectives — the golden-pinned default)
+        or the segment count the layerwise-overlap chunked pair
+        exchanges split their payload into
+        (``cfg.overlap_collectives='layerwise'``; ops/collectives
+        ``all_gather_pairs(segments=...)``). Segmentation is pure data
+        movement, bit-equal to the monolithic gather."""
+        if getattr(self.cfg, "overlap_collectives", "none") == "layerwise":
+            from commefficient_tpu.ops.collectives import OVERLAP_SEGMENTS
+
+            return OVERLAP_SEGMENTS
+        return None
+
     # ---- validation ------------------------------------------------------
     def validate(self) -> None:
         """Raise on unsupported (mode, error_type) combinations — the

@@ -195,7 +195,8 @@ class SketchCompressor(Compressor):
                 self._shard_estimate_at()(spec, m, hh_gidx), 0.0,
             )
             if self._ride_pair_exchange:
-                g_i, g_v = all_gather_pairs(hh_gidx, m_at_hh, axis_name)
+                g_i, g_v = all_gather_pairs(hh_gidx, m_at_hh, axis_name,
+                                            segments=self.overlap_segments)
                 m = m - sketch_sparse(spec, g_i, g_v).astype(spec.table_dtype)
             else:
                 m = m - jax.lax.psum(
@@ -210,8 +211,8 @@ class SketchCompressor(Compressor):
         loc, val = compact_nonzero(sel, cfg.k)
         gidx = jnp.minimum(my * S + loc, d - 1)  # padding rows clip
         # in-range; their val is 0.0, so the apply scatter ignores them
-        g_idx = jax.lax.all_gather(gidx, axis_name).reshape(-1)
-        g_val = jax.lax.all_gather(val, axis_name).reshape(-1)
+        g_idx, g_val = all_gather_pairs(gidx, val, axis_name,
+                                        segments=self.overlap_segments)
         return g_idx, g_val, self._down(new_m), self._down(e), extra
 
     @staticmethod
@@ -259,7 +260,8 @@ class SketchCompressor(Compressor):
                 # aggregate='sparse': the table psum becomes a <= Wd*k
                 # pair all_gather + ONE local re-sketch of all pairs
                 # (linearity — same table up to f32 summation order)
-                g_i, g_v = all_gather_pairs(idx_c[loc], val, axis_name)
+                g_i, g_v = all_gather_pairs(idx_c[loc], val, axis_name,
+                                            segments=self.overlap_segments)
                 e = e - sketch_sparse(spec, g_i, g_v).astype(spec.table_dtype)
             else:
                 e = e - jax.lax.psum(

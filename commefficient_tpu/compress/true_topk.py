@@ -127,5 +127,6 @@ class TrueTopkCompressor(Compressor):
         my = jax.lax.axis_index(axis_name)
         loc, val = compact_nonzero(delta_sh, self.cfg.k)
         gidx = jnp.minimum(my * S + loc, d - 1)  # clip padding coords
-        g_idx, g_val = all_gather_pairs(gidx, val, axis_name)
+        g_idx, g_val = all_gather_pairs(gidx, val, axis_name,
+                                        segments=self.overlap_segments)
         return g_idx, g_val, m, e, extra

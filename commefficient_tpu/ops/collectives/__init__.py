@@ -2,20 +2,28 @@
 
 See ``sparse_allreduce`` for the design notes (gather form vs the
 recursive-halving ``ppermute`` form, and which ``shard_map`` out_specs
-each is legal under).
+each is legal under), and the segmented twins (``psum_segments``,
+``all_gather_pairs(segments=...)``) backing
+``overlap_collectives='layerwise'``.
 """
 
 from commefficient_tpu.ops.collectives.sparse_allreduce import (
+    OVERLAP_SEGMENTS,
     all_gather_pairs,
     compact_pairs,
+    psum_segments,
+    psum_segments_fused,
     scatter_add_pairs,
     sparse_allreduce,
     sparse_allreduce_sharded,
 )
 
 __all__ = [
+    "OVERLAP_SEGMENTS",
     "all_gather_pairs",
     "compact_pairs",
+    "psum_segments",
+    "psum_segments_fused",
     "scatter_add_pairs",
     "sparse_allreduce",
     "sparse_allreduce_sharded",

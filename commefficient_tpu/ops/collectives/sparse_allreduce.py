@@ -34,6 +34,18 @@ Two exchange schedules:
 Both forms equal the dense psum up to f32 summation order.  Pair buffers
 are fixed-size with ``(0, 0.0)`` padding, so scatter-adding padding is a
 no-op and every shape is static (zero retraces).
+
+Collective/compute overlap (``overlap_collectives='layerwise'``): the
+segmented twins here split ONE collective into independent per-segment
+collectives so XLA's latency-hiding scheduler may run them concurrently
+with surrounding compute (or each other).  Segmentation never touches
+arithmetic: ``all_gather_pairs(segments=S)`` is pure data movement (the
+ordered concatenation of segment gathers IS the monolithic gather,
+bit-equal), and ``psum_segments`` relies on an all-reduce being
+ELEMENTWISE — each element's cross-worker sum happens once, in ring
+order, whichever collective op carries it, so per-segment psums are
+bit-equal to one psum of the concatenated segments (no reassociation
+within a segment).
 """
 
 from __future__ import annotations
@@ -47,6 +59,20 @@ from commefficient_tpu.ops.topk import compact_nonzero
 
 Array = jax.Array
 
+# Default segment count for the layerwise-overlap chunked exchanges: 4
+# in-flight collectives is enough for the latency-hiding scheduler to
+# pipeline without shrinking any single message below the bandwidth-bound
+# regime at the W*k pair sizes the sparse modes move.
+OVERLAP_SEGMENTS = 4
+
+
+def _segment_bounds(n: int, segments: int):
+    """Static [start, stop) bounds splitting [0, n) into up to
+    ``segments`` contiguous near-equal chunks (every chunk non-empty)."""
+    s = max(1, min(int(segments), int(n)))
+    step = -(-n // s)
+    return [(a, min(a + step, n)) for a in range(0, n, step)]
+
 
 def compact_pairs(v: Array, capacity: int) -> Tuple[Array, Array]:
     """``(idx, val)`` pair buffer of the first ``capacity`` nonzeros of
@@ -56,14 +82,63 @@ def compact_pairs(v: Array, capacity: int) -> Tuple[Array, Array]:
     return compact_nonzero(v, capacity)
 
 
-def all_gather_pairs(idx: Array, val: Array,
-                     axis_name: str) -> Tuple[Array, Array]:
+def all_gather_pairs(idx: Array, val: Array, axis_name: str,
+                     segments=None) -> Tuple[Array, Array]:
     """Concatenate every shard's [kb] pair buffer into replicated
     [N·kb] buffers (N = axis size).  Invariant output — legal to return
-    from ``shard_map`` under ``out_specs=P()``."""
-    g_idx = jax.lax.all_gather(idx, axis_name).reshape(-1)
-    g_val = jax.lax.all_gather(val, axis_name).reshape(-1)
+    from ``shard_map`` under ``out_specs=P()``.
+
+    ``segments=S`` (layerwise overlap) splits the [kb] payload into up
+    to S contiguous chunks, each exchanged by its own ``all_gather``;
+    concatenating the [N, kb_s] gathers along the pair axis rebuilds the
+    exact monolithic [N, kb] layout, so the flattened output — and
+    everything scatter-added from it — is BIT-equal to ``segments=None``
+    (pure data movement, no arithmetic).  ``None`` (default) traces the
+    single-gather program byte-identically to pre-overlap builds."""
+    if segments is None or int(segments) <= 1 or idx.shape[0] <= 1:
+        g_idx = jax.lax.all_gather(idx, axis_name).reshape(-1)
+        g_val = jax.lax.all_gather(val, axis_name).reshape(-1)
+        return g_idx, g_val
+    bounds = _segment_bounds(idx.shape[0], segments)
+    g_idx = jnp.concatenate(
+        [jax.lax.all_gather(idx[a:b], axis_name) for a, b in bounds], axis=1
+    ).reshape(-1)
+    g_val = jnp.concatenate(
+        [jax.lax.all_gather(val[a:b], axis_name) for a, b in bounds], axis=1
+    ).reshape(-1)
     return g_idx, g_val
+
+
+def psum_segments(segments, axis_name):
+    """Sum each segment array across ``axis_name`` with its OWN psum —
+    independent collectives the latency-hiding scheduler may issue as
+    soon as each segment's producer finishes (the layerwise-overlap form
+    of one monolithic psum over the concatenated segments).
+
+    An all-reduce is elementwise: every element's cross-worker sum is
+    performed once, in the axis reduction order, regardless of which
+    collective op carries it — so this is BIT-equal, element for
+    element, to ``psum(concat(segments))`` split back apart
+    (``tests/test_overlap_collectives.py`` pins it on a real mesh).
+    Segments may differ in shape; dtypes follow each input."""
+    return tuple(jax.lax.psum(s, axis_name) for s in segments)
+
+
+def psum_segments_fused(segments, axis_name):
+    """The monolithic twin of ``psum_segments``: ONE psum of the
+    flattened-and-concatenated segments, split back to the input shapes.
+    Exists as the bit-equality reference for the overlap pin (and as the
+    spelling of the claim: segmentation changes only which collective
+    carries an element, never its reduction).  All segments must share a
+    dtype (they do — per-leaf-group sketch tables)."""
+    flat = jnp.concatenate([s.reshape(-1) for s in segments])
+    summed = jax.lax.psum(flat, axis_name)
+    out, off = [], 0
+    for s in segments:
+        n = s.size
+        out.append(summed[off:off + n].reshape(s.shape))
+        off += n
+    return tuple(out)
 
 
 def scatter_add_pairs(dim: int, idx: Array, val: Array) -> Array:
@@ -75,14 +150,18 @@ def scatter_add_pairs(dim: int, idx: Array, val: Array) -> Array:
     return jnp.zeros((n,), val.dtype).at[idx].add(val)
 
 
-def sparse_allreduce(v: Array, capacity: int, axis_name: str) -> Array:
+def sparse_allreduce(v: Array, capacity: int, axis_name: str,
+                     segments=None) -> Array:
     """Allreduce a ≤capacity-sparse dense [d] vector across ``axis_name``
     by exchanging only (idx, val) pairs: compact → all_gather → local
     scatter-add.  Returns the replicated dense [d] sum (invariant), equal
     to ``psum(v, axis_name)`` up to f32 summation order whenever each
-    shard's ``v`` has at most ``capacity`` nonzeros."""
+    shard's ``v`` has at most ``capacity`` nonzeros.  ``segments``
+    chunks the gather (layerwise overlap) — the gathered pairs, and
+    therefore the single scatter-add consuming them, are bit-equal to
+    the monolithic exchange (see ``all_gather_pairs``)."""
     idx, val = compact_pairs(v, capacity)
-    g_idx, g_val = all_gather_pairs(idx, val, axis_name)
+    g_idx, g_val = all_gather_pairs(idx, val, axis_name, segments=segments)
     return scatter_add_pairs(v.shape[0], g_idx, g_val)
 
 

@@ -173,6 +173,11 @@ class FederatedSession:
         # attaches one at telemetry_level >= 1 — None keeps every span
         # site on the zero-cost fast path.
         self.spans = None
+        # last compiled-round audit (telemetry/xla_audit.py), kept for the
+        # xla/exposed_collective_ms spans×HLO cross-check: the spans-side
+        # exposure is only a collective wait if the compiled program
+        # actually contains collectives.
+        self.last_audit = None
         # adaptive-communication controller (control/): attached by
         # build_controller at train-entry time (it needs the run length);
         # None keeps every round on the untouched fast path.
@@ -887,14 +892,17 @@ class FederatedSession:
         return (live, corr, cnt), dict(env.stats)
 
     # -- host-side round observability (telemetry) -------------------------
-    def _span(self, name: str, fence=None):
+    def _span(self, name: str, fence=None, collective: bool = False):
         """Phase-span context (telemetry/spans.py) — a nullcontext yielding
-        None unless a train loop attached a recorder (level >= 1)."""
+        None unless a train loop attached a recorder (level >= 1).
+        ``collective=True`` tags the span for the exposed-collective
+        accounting (the round-dispatch spans: their fence waits on the
+        program's aggregation collectives)."""
         if self.spans is None:
             from contextlib import nullcontext
 
             return nullcontext()
-        return self.spans.span(name, fence=fence)
+        return self.spans.span(name, fence=fence, collective=collective)
 
     def _host_round_stats(self, fs_stats: dict) -> dict:
         """Host scalars riding this round's metric dict: the fedsim stats,
@@ -905,6 +913,14 @@ class FederatedSession:
         stats = dict(fs_stats)
         if self.cfg.telemetry_level >= 1:
             stats["xla/retraces"] = float(self.retrace_sentinel.retraces)
+            if self.spans is not None:
+                from commefficient_tpu.telemetry.xla_audit import (
+                    exposed_collective_ms,
+                )
+
+                stats["xla/exposed_collective_ms"] = exposed_collective_ms(
+                    self.spans, self.last_audit
+                )
         if self.controller is not None:
             stats.update(self.controller.scalars())
         if self.resilience is not None:
@@ -926,7 +942,7 @@ class FederatedSession:
         with self._span("fedsim_env"):
             fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
-        with self._span("round_dispatch") as sp:
+        with self._span("round_dispatch", collective=True) as sp:
             self.state, metrics = self._round_idx_fn(
                 self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr),
                 env=fs_env,
@@ -949,7 +965,7 @@ class FederatedSession:
             fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
         if not self.cfg.offload_client_state:
-            with self._span("round_dispatch") as sp:
+            with self._span("round_dispatch", collective=True) as sp:
                 self.state, metrics = self.round_fn(
                     self.state, ids, dev_batch, lr, env=fs_env
                 )
@@ -970,7 +986,7 @@ class FederatedSession:
             if self.host_err is not None
             else ()
         )
-        with self._span("round_dispatch") as sp:
+        with self._span("round_dispatch", collective=True) as sp:
             self.state, metrics, new_vel, new_err = self.round_fn(
                 self.state, ids, dev_batch, lr, vel_rows, err_rows, env=fs_env
             )
@@ -1099,11 +1115,13 @@ class FederatedSession:
         fs_env, _ = self._fedsim_round_env(env)
         lowered = self.round_fn.lower(*args, env=fs_env)
         compiled = lowered.compile()
-        return CompiledRoundAudit.from_compiled(
+        audit = CompiledRoundAudit.from_compiled(
             compiled,
             engine="fsdp" if self.cfg.fsdp else "replicated",
             **self._audit_bounds(cids),
         )
+        self.last_audit = audit
+        return audit
 
     def _audit_bounds(self, cids) -> Dict[str, Any]:
         """The ledger/collective bounds every compiled-round audit is
@@ -1157,6 +1175,16 @@ class FederatedSession:
                 sparse_agg_bound = max(
                     sparse_agg_bound, cids.shape[0] * self.grad_size
                 )
+        # collective-hiding attribution (schema v9): the block rides the
+        # report exactly when a hiding mode is ON, so downstream wall-clock
+        # comparisons can never mix overlapped and sequential figures
+        overlap_info = None
+        if (self.cfg.overlap_collectives != "none"
+                or self.cfg.async_double_buffer):
+            overlap_info = {
+                "collectives": self.cfg.overlap_collectives,
+                "double_buffer": bool(self.cfg.async_double_buffer),
+            }
         return dict(
             mode=self.cfg.mode,
             sketch_decode=self.sketch_decode_resolved if is_sketch else None,
@@ -1169,6 +1197,7 @@ class FederatedSession:
             tolerance_bytes=ledger_tolerance(
                 up, sharded=sharded, workers=W, k=k_active
             ),
+            overlap_info=overlap_info,
         )
 
     # -- asyncfed programs -------------------------------------------------
@@ -1237,7 +1266,7 @@ class FederatedSession:
         compiled = apply_fn.lower(
             self.state, *out, ids, weights, jnp.float32(W), jnp.float32(lr)
         ).compile()
-        return CompiledRoundAudit.from_compiled(
+        audit = CompiledRoundAudit.from_compiled(
             compiled,
             engine="async",
             async_info={
@@ -1247,6 +1276,8 @@ class FederatedSession:
             },
             **self._audit_bounds(cids),
         )
+        self.last_audit = audit
+        return audit
 
     def bytes_per_round(self) -> Dict[str, int]:
         """Upload/download bytes per participating client (BASELINE.md

@@ -71,7 +71,11 @@ from jax.flatten_util import ravel_pytree
 from commefficient_tpu.compress import get_compressor
 from commefficient_tpu.compress.base import KIND_DENSE
 from commefficient_tpu.models.losses import IGNORE_INDEX
-from commefficient_tpu.ops.collectives import sparse_allreduce
+from commefficient_tpu.ops.collectives import (
+    OVERLAP_SEGMENTS,
+    psum_segments,
+    sparse_allreduce,
+)
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
 from commefficient_tpu.parallel.mesh import WORKERS
@@ -206,8 +210,35 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
     return grad_one
 
 
+def leaf_groups(sizes, segments):
+    """Partition leaf indices [0, len(sizes)) into up to ``segments``
+    CONTIGUOUS non-empty groups of near-equal cumulative size — the
+    layerwise-overlap bucketing (contiguous in ravel_pytree order ≈
+    layer order, so each group's table cotangent completes as backprop
+    crosses its layers). Returns a list of (start, stop) leaf-index
+    bounds covering every leaf exactly once."""
+    n = len(sizes)
+    g = max(1, min(int(segments), n))
+    cum, total = [], 0
+    for sz in sizes:
+        total += sz
+        cum.append(total)
+    bounds, start = [], 0
+    for k in range(1, g + 1):
+        target = total * k / g
+        stop = start + 1
+        while stop < n and cum[stop - 1] < target:
+            stop += 1
+        stop = min(stop, n - (g - k))  # leave >= 1 leaf per later group
+        bounds.append((start, stop))
+        start = stop
+    bounds[-1] = (bounds[-1][0], n)
+    return bounds
+
+
 def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
-                         mesh, spec: CountSketch, *, d: int):
+                         mesh, spec: CountSketch, *, d: int,
+                         overlap_segments: Optional[int] = None):
     """Sketch-FUSED twin of ``make_grad_one`` for the fused flattened-batch
     path: ``(params_vec, batch, noise_rng) -> (grad TABLE [r, c_actual]
     f32, loss, aux)``.
@@ -225,6 +256,18 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
     materialized) params vector. Gates (validated by Config): no clip, no
     DP noise, no local momentum, no fedsim — exactly the fused-path
     conditions, where one gradient per device exists.
+
+    ``overlap_segments`` (layerwise overlap): partition the leaves into
+    up to that many contiguous size-balanced groups (``leaf_groups``)
+    and differentiate w.r.t. a TUPLE of per-GROUP tables — AD then
+    finishes each group's table cotangent as backprop crosses its
+    layers, so the caller can issue one psum per group the moment it
+    exists (FSDP-style bucketed overlap; the sum of the group tables
+    equals the monolithic table up to cotangent fan-in summation order,
+    the same tolerance class the fused backward itself carries vs the
+    dense-grad path). Returns ``(tuple of [r, c] tables, loss, aux)``
+    in that case; ``None`` (default) traces the single-table program
+    byte-identically to pre-overlap builds.
     """
     from commefficient_tpu.ops.countsketch import (
         sketch_grad_tap,
@@ -242,6 +285,10 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
     offsets = [0]
     for sz in sizes[:-1]:
         offsets.append(offsets[-1] + sz)
+
+    groups = (
+        leaf_groups(sizes, overlap_segments) if overlap_segments else None
+    )
 
     def grad_one_table(params_vec, batch, noise_rng):
         del noise_rng  # DP noise is a [D]-vector draw — gated off this path
@@ -271,7 +318,43 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
             )
         return table, loss, aux
 
-    return grad_one_table
+    def grad_group_tables(params_vec, batch, noise_rng):
+        # layerwise overlap: one dummy zeros table PER LEAF GROUP —
+        # each tap's backward sketches into its group's table, so a
+        # group's cotangent is complete the moment backprop has crossed
+        # its layers (no later layer writes it), and the caller may
+        # psum it while earlier groups still differentiate
+        del noise_rng
+
+        def tapped(tables):
+            params = unravel(params_vec)
+            leaves, treedef = jax.tree.flatten(params)
+            tapped_leaves = list(leaves)
+            for gi, (a, b) in enumerate(groups):
+                for i in range(a, b):
+                    tapped_leaves[i] = sketch_grad_tap(
+                        spec, offsets[i], leaves[i], tables[gi]
+                    )
+            return loss_fn(jax.tree.unflatten(treedef, tapped_leaves), batch)
+
+        zeros = tuple(
+            jnp.zeros(spec.table_shape, jnp.float32) for _ in groups
+        )
+        (loss, aux), tables = jax.value_and_grad(tapped, has_aux=True)(zeros)
+        tables = tuple(
+            grad_extra_axes_psum(t, mesh, WORKERS) for t in tables
+        )
+        if cfg.weight_decay:
+            # wd rides the FIRST group's table (the one whose cotangent
+            # completes last, so no overlap window shrinks): the group
+            # tables only ever matter through their sum
+            wd = cfg.weight_decay * sketch_vec(
+                spec._replace(table_dtype=jnp.float32), params_vec
+            )
+            tables = (tables[0] + wd,) + tables[1:]
+        return tables, loss, aux
+
+    return grad_group_tables if groups is not None else grad_one_table
 
 
 def sum_client_grads(grad_one, params_vec, batch, client_ids, rng, *,
@@ -399,11 +482,34 @@ def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
     over the workers axis: ``(local encoded transmit sum, loss_local, aux
     tree, w_loc) -> (agg, loss_mean, aux_sum)``. Extracted verbatim from
     ``worker_shard`` so the synchronous round and the asyncfed apply
-    program share one collective layout per plan."""
+    program share one collective layout per plan.
+
+    Layerwise overlap (``cfg.overlap_collectives``): a TUPLE ``local``
+    is the sketch-fused backward's per-leaf-group tables — each group
+    gets its OWN psum (``psum_segments``) so the latency-hiding
+    scheduler can issue it as soon as backprop finishes that group;
+    the per-segment psums are bit-equal to one psum of the same
+    segments, and the on-chip group sum is the cotangent fan-in the
+    monolithic table would have performed (same tolerance class as the
+    fused backward itself). The sparse_allreduce leg chunks its pair
+    gather (pure data movement — bit-equal)."""
+    segs = (
+        OVERLAP_SEGMENTS if cfg.overlap_collectives == "layerwise" else None
+    )
 
     def aggregate_tail(local, loss_local, aux, w_loc):
         aux_leaves, aux_def = jax.tree.flatten(aux)
-        if plan.sparse_state:
+        if isinstance(local, tuple):
+            # sketch-fused layerwise: one psum per leaf-group table,
+            # issued inside the shard body as the backward produces them
+            with jax.named_scope("overlap_layerwise_psum"):
+                summed_t = psum_segments(local, WORKERS)
+            agg = summed_t[0].astype(jnp.float32)
+            for t in summed_t[1:]:
+                agg = agg + t.astype(jnp.float32)
+            agg = agg / W
+            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+        elif plan.sparse_state:
             # true_topk sparse aggregation: reduce-scatter the dense
             # transmit sum — each chip keeps only its balanced [S] slice
             # of the padded [dp] vector (no O(D) all-reduce ever; the
@@ -425,7 +531,8 @@ def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
             # order, and everything downstream is byte-for-byte the dense
             # server path
             with jax.named_scope("sparse_allreduce"):
-                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS) / W
+                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS,
+                                       segments=segs) / W
             summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
         else:
             # dense path: ONE fused all-reduce carries agg+loss+aux (the
@@ -689,8 +796,17 @@ def build_round_fn(
             f"a fused-backward-capable compressor (mode={cfg.mode!r}, "
             f"fused={fused}) — Config validation should have caught this"
         )
+    # layerwise collective overlap (cfg.overlap_collectives): the fused
+    # backward produces per-leaf-group tables so the aggregation tail can
+    # psum each the moment backprop finishes it — a python-level gate
+    # like telemetry_level (overlap='none' traces byte-identically to a
+    # pre-overlap build; tests/test_overlap_collectives.py pins it)
+    overlap_layerwise = cfg.overlap_collectives == "layerwise"
     grad_table_one = (
-        make_sketch_grad_one(cfg, loss_fn, unravel, mesh, spec, d=d)
+        make_sketch_grad_one(
+            cfg, loss_fn, unravel, mesh, spec, d=d,
+            overlap_segments=OVERLAP_SEGMENTS if overlap_layerwise else None,
+        )
         if sketch_fused
         else None
     )
@@ -744,7 +860,14 @@ def build_round_fn(
             )
             with jax.named_scope("sketch_fused_bwd"):
                 table, loss_flat, aux = grad_table_one(params_vec, flat, rng)
-            local = comp.encode_grad_table(w_loc * table)
+            if overlap_layerwise:
+                # per-leaf-group tables (a tuple): encode each group —
+                # the aggregate tail psums them segment-by-segment
+                local = tuple(
+                    comp.encode_grad_table(w_loc * t) for t in table
+                )
+            else:
+                local = comp.encode_grad_table(w_loc * table)
             loss_local = w_loc * loss_flat
             new_vel = jnp.zeros((w_loc, 1), f32)
             new_err = jnp.zeros((w_loc, 1), f32)

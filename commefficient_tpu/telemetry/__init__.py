@@ -79,6 +79,7 @@ from commefficient_tpu.telemetry.xla_audit import (
     audited_mfu,
     chip_peak_flops,
     collective_audit,
+    exposed_collective_ms,
 )
 
 # versioned schema shared by metrics.jsonl headers, flight_*.json,
@@ -129,7 +130,18 @@ from commefficient_tpu.telemetry.xla_audit import (
 # ones). Byte billing is unchanged by design: an async update's ledger
 # row bills the consumed contributions' uploads, so overlapping cohorts'
 # bytes sum exactly to the synchronous ledger under concurrency 1.
-SCHEMA_VERSION = 8
+# v9 (hidden-collectives PR): the xla/exposed_collective_ms scalar — a
+# spans×HLO cross-check (telemetry/xla_audit.exposed_collective_ms) of
+# the host-measured un-overlapped collective wait, non-negative and
+# pinned to 0.0 when the compiled round contains no collectives; spans
+# events may carry args.collective == true (the tag driving the
+# exposure accounting) and spans_*.json a top-level
+# "exposed_collective_ms" field; perf_report.json gains an "overlap"
+# block {collectives: 'none'|'layerwise', double_buffer: bool} REQUIRED
+# exactly when a collective-hiding mode is on (overlap_collectives !=
+# 'none' or async_double_buffer) and forbidden otherwise, so wall-clock
+# rows are always attributable to their overlap setting.
+SCHEMA_VERSION = 9
 
 TELEMETRY_LEVELS = (0, 1, 2)
 
@@ -257,6 +269,7 @@ __all__ = [
     "build_telemetry_riders",
     "chip_peak_flops",
     "collective_audit",
+    "exposed_collective_ms",
     "jsonable_scalar",
     "jsonable_tree",
     "nonfinite_sentinel",

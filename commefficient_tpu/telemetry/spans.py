@@ -35,13 +35,25 @@ Recording is thread-safe (lock-guarded lane map; deque appends are
 atomic); spans from a worker thread should pass ``step=`` explicitly —
 the shared round clock belongs to the consuming thread.
 
+Collective exposure (schema v9): spans that bracket a phase whose device
+program waits on a cross-chip collective pass ``collective=True`` — the
+event's args gain ``"collective": true`` and ``collective_exposure_ms()``
+computes the union of collective-span intervals NOT covered by any other
+(compute) span. That difference is the host-visible stall a collective
+causes when nothing overlaps it; ``overlap_collectives='layerwise'`` and
+``async_double_buffer`` exist to shrink it. The dump carries the number
+as a top-level ``"exposed_collective_ms"`` field so the audit's
+spans×HLO cross-check (telemetry/xla_audit.py ``exposed_collective_ms``)
+can gate it on the compiled programs actually containing collectives.
+
 Format: ``{"schema_version", "kind": "spans", "displayTimeUnit",
-"traceEvents": [{"name", "ph": "X", "ts", "dur", "pid", "tid",
-"args": {"step", "fenced"}} | {"name": "thread_name", "ph": "M", "pid",
-"tid", "args": {"name"}}]}`` — ts/dur in microseconds since the recorder
-was constructed (Chrome trace convention). Validated by
-scripts/check_telemetry_schema.py (schema v3; "M" thread-name metadata
-events since v5).
+"exposed_collective_ms", "traceEvents": [{"name", "ph": "X", "ts",
+"dur", "pid", "tid", "args": {"step", "fenced"[, "collective"]}} |
+{"name": "thread_name", "ph": "M", "pid", "tid", "args": {"name"}}]}``
+— ts/dur in microseconds since the recorder was constructed (Chrome
+trace convention). Validated by scripts/check_telemetry_schema.py
+(schema v3; "M" thread-name metadata events since v5;
+``exposed_collective_ms`` since v9).
 """
 
 from __future__ import annotations
@@ -147,7 +159,8 @@ class PhaseSpans:
 
     # -- recording ---------------------------------------------------------
     @contextmanager
-    def span(self, name: str, fence=None, step: Optional[int] = None):
+    def span(self, name: str, fence=None, step: Optional[int] = None,
+             collective: bool = False):
         """Record one phase. Yields a handle whose ``fence(x)`` arms a
         scalar-fetch sync on ``x`` before the span closes (for targets only
         known inside the block, e.g. the dispatched round's metrics);
@@ -156,7 +169,10 @@ class PhaseSpans:
         perf_counter calls. ``step=`` stamps the event with an explicit
         round index — worker-thread spans (the prefetch lane) pass the
         round they are REALIZING; the shared ``step()`` clock belongs to
-        the consuming thread. Yields None when the recorder is disabled."""
+        the consuming thread. ``collective=True`` tags the span as waiting
+        on a cross-chip collective — ``collective_exposure_ms()`` then
+        charges any part of it not covered by another span as exposed
+        (un-overlapped) collective time. Yields None when disabled."""
         if not self.enabled:
             yield None
             return
@@ -173,6 +189,10 @@ class PhaseSpans:
                 fenced = True
         finally:
             t1 = time.perf_counter()
+            args = {"step": self._step if step is None else int(step),
+                    "fenced": fenced}
+            if collective:
+                args["collective"] = True
             self.events.append({
                 "name": name,
                 "ph": "X",
@@ -180,8 +200,7 @@ class PhaseSpans:
                 "dur": (t1 - t0) * 1e6,
                 "pid": 0,
                 "tid": self._lane(),
-                "args": {"step": self._step if step is None else int(step),
-                         "fenced": fenced},
+                "args": args,
             })
 
     def wrap_iter(self, it, name: str = "data_load"):
@@ -203,6 +222,52 @@ class PhaseSpans:
                     return
             yield item
 
+    # -- collective exposure -----------------------------------------------
+    def collective_exposure_ms(self) -> float:
+        """Wall-clock (ms) spent inside ``collective=True`` spans and NOT
+        covered by any other recorded span — the un-overlapped (exposed)
+        part of the collective waits. Interval arithmetic over the event
+        ring: union the collective spans, union the compute spans,
+        measure the set difference. 0.0 when nothing is tagged."""
+        coll, comp = [], []
+        for ev in self.events:
+            if ev.get("ph") != "X":
+                continue
+            iv = (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
+            if ev.get("args", {}).get("collective"):
+                coll.append(iv)
+            else:
+                comp.append(iv)
+        if not coll:
+            return 0.0
+
+        def union(ivs):
+            out = []
+            for a, b in sorted(ivs):
+                if out and a <= out[-1][1]:
+                    out[-1][1] = max(out[-1][1], b)
+                else:
+                    out.append([a, b])
+            return out
+
+        comp_u = union(comp)
+        exposed_us = 0.0
+        for a, b in union(coll):
+            cur = a
+            for ca, cb in comp_u:
+                if cb <= cur:
+                    continue
+                if ca >= b:
+                    break
+                if ca > cur:
+                    exposed_us += ca - cur
+                cur = max(cur, cb)
+                if cur >= b:
+                    break
+            if cur < b:
+                exposed_us += b - cur
+        return exposed_us / 1000.0
+
     # -- dump --------------------------------------------------------------
     def dump(self) -> Optional[str]:
         """Write ``spans_<step>.json`` (step = first recorded round);
@@ -219,6 +284,7 @@ class PhaseSpans:
             "kind": "spans",
             "displayTimeUnit": "ms",
             "window": [self.start, self.stop_at],
+            "exposed_collective_ms": self.collective_exposure_ms(),
             "traceEvents": self._meta_events + list(self.events),
         }
         with open(path, "w") as f:

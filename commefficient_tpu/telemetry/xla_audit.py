@@ -162,8 +162,14 @@ def collective_audit(hlo_text: str) -> Dict[str, Any]:
             # tuple on TPU — counting the operand alias would inflate the
             # bytes AND max_all_gather_elems past the W*k bound on a
             # perfectly clean sharded round; the transferred buffer is the
-            # second component
-            shapes = shapes[1:]
+            # second component. collective-permute-start is pinned to
+            # EXACTLY that component: its tuple trails u32[] context
+            # scalars (source/target pair bookkeeping) that the shape
+            # regex would otherwise parse as real 4-byte buffers and
+            # double-count; the matching -done lines carry no "...(" op
+            # call of their own, so the pair is counted once here
+            shapes = (shapes[1:2] if op == "collective-permute"
+                      else shapes[1:])
         line_elems = sum(n for n, _ in shapes)
         line_bytes = sum(b for _, b in shapes)
         ops[op]["count"] += 1
@@ -190,6 +196,24 @@ def ledger_tolerance(upload_bytes: int, *, sharded: bool = False,
     if sharded:
         tol += int(upload_bytes) + 8 * int(workers) * int(k)
     return tol
+
+
+def exposed_collective_ms(spans, audit=None) -> float:
+    """The ``xla/exposed_collective_ms`` scalar: host-measured
+    un-overlapped collective wait, cross-checked against the compiled
+    artifact. The spans side (telemetry/spans.py
+    ``collective_exposure_ms``) measures the union of collective-tagged
+    span intervals NOT covered by any other span; the HLO side gates it —
+    when the audited program contains no collectives at all (a 1-device
+    run fences just as long on pure compute), the spans' number is host
+    noise and the metric is pinned to 0.0. Without an audit (perf_audit
+    off, or the analysis degraded) the spans measurement stands alone:
+    an honest host-side reading beats a fake zero."""
+    if spans is None:
+        return 0.0
+    if audit is not None and not audit.collectives_present:
+        return 0.0
+    return float(spans.collective_exposure_ms())
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +297,7 @@ class CompiledRoundAudit:
                  sparse_agg_bound: Optional[int] = None,
                  tolerance_bytes: Optional[int] = None,
                  async_info: Optional[dict] = None,
+                 overlap_info: Optional[dict] = None,
                  hlo_unavailable_reason: Optional[str] = None):
         self.cost = cost
         self.memory = memory
@@ -283,6 +308,12 @@ class CompiledRoundAudit:
         # geometry {buffer, concurrency, staleness_exponent}; None on
         # synchronous rounds (the v8 schema forbids the block there)
         self.async_info = dict(async_info) if async_info else None
+        # collective-hiding state {collectives, double_buffer} — present
+        # exactly when one of the hiding modes is ON (the v9 schema
+        # forbids the block on a report whose config has both off), so a
+        # wall-clock figure downstream can never be misattributed to the
+        # wrong overlap setting
+        self.overlap_info = dict(overlap_info) if overlap_info else None
         # resolved --aggregate path (None when the compressor has no sparse
         # aggregation capability): 'sparse' arms the checker's no-O(D)
         # all-reduce/all-gather enforcement against sparse_agg_bound
@@ -302,6 +333,13 @@ class CompiledRoundAudit:
             coll["tolerance_bytes"] = int(tol)
             coll["within_tolerance"] = abs(delta) <= int(tol)
         self.collectives = coll
+
+    @property
+    def collectives_present(self) -> bool:
+        """Whether the compiled program contains ANY collective op — the
+        HLO side of the ``exposed_collective_ms`` spans×HLO cross-check."""
+        return any(v.get("count", 0) > 0
+                   for v in self.collectives.get("ops", {}).values())
 
     @classmethod
     def from_compiled(cls, compiled, **kw) -> "CompiledRoundAudit":
@@ -383,6 +421,8 @@ class CompiledRoundAudit:
         }
         if self.async_info is not None:
             rec["async"] = dict(self.async_info)
+        if self.overlap_info is not None:
+            rec["overlap"] = dict(self.overlap_info)
         if extra:
             rec.update(extra)
         return jsonable_tree(rec)

@@ -271,6 +271,28 @@ class Config:
     # engage only on an explicit "sparse" (their summation order or state
     # placement changes). See README "Sparse allreduce collective layer".
     aggregate: str = "auto"
+    # Collective/compute overlap ("none" | "layerwise"). "layerwise"
+    # chunks the round's aggregation collectives into independent
+    # segments so XLA's latency-hiding scheduler can run them
+    # concurrently with remaining compute: the sketch-FUSED backward
+    # (sketch_fused_bwd) accumulates per-leaf-GROUP tables and psums
+    # each group as its own collective the moment backprop finishes
+    # producing it (FSDP-style bucketed overlap — early layers'
+    # aggregation starts while later layers still differentiate), and
+    # the sparse pair exchanges (local_topk / true_topk / the sketch
+    # EF ride) split their W*k all_gather into segment gathers whose
+    # ordered concatenation is BIT-equal to the monolithic gather
+    # (pure data movement). Segmented psums are bit-equal to ONE psum
+    # of the same segments (an all-reduce is elementwise; no
+    # reassociation within a segment) — but per-GROUP table
+    # accumulation reorders the per-chip cotangent fan-in, so the
+    # fused-backward layerwise round tracks overlap="none" at the same
+    # summation-order tolerance sketch_fused_bwd itself is pinned to.
+    # "none" (default): nothing overlap-related is traced and the round
+    # stays byte-identical to a pre-overlap build (the telemetry_level-0
+    # discipline; golden recordings pin it). See README "Hiding the
+    # collectives".
+    overlap_collectives: str = "none"
     # CountSketch kernel backend for the matmul-path ops ("einsum" |
     # "pallas"). "einsum" (default): the banded one-hot einsum +
     # overlap-add — runs everywhere, the r1-r5 production path. "pallas":
@@ -415,6 +437,19 @@ class Config:
     # advanced since the contribution's cohort launched (FedBuff/
     # FedAsync-style). 0 = no discount (pure live-mask weighting).
     staleness_exponent: float = 0.0
+    # Double-buffered round overlap (asyncfed/engine.py): defer the
+    # host fence on update u's applied metrics until AFTER update
+    # u+1's cohort launches have been dispatched, so the launch
+    # programs' forward/backward queues behind the in-flight apply and
+    # the device never waits on the host between an apply and the next
+    # launches. Pure host scheduling — every value the engine computes
+    # (staleness weights, consumed bookkeeping, the applied update) is
+    # unchanged, so the K=W, C=1, alpha=0 anchor still reduces
+    # BIT-IDENTICALLY to the synchronous round. Requires the asyncfed
+    # engine (async_buffer > 0). False (default): the apply fences
+    # inside its own span before the next launches (the measured
+    # sequential baseline).
+    async_double_buffer: bool = False
 
     # --- adaptive communication budget (commefficient_tpu/control/;
     # TPU-native — the reference fixes k/num_cols/rank once per run) ---
@@ -658,6 +693,7 @@ class Config:
                 f"got {self.sketch_table_dtype!r}"
             )
         self._validate_sketch_fused_bwd()
+        self._validate_overlap_collectives()
         self._validate_scan_rounds()
         if self.num_workers % self.num_devices != 0:
             raise ValueError(
@@ -784,6 +820,21 @@ class Config:
                 "the vmap path) — run one or the other"
             )
 
+    def _validate_overlap_collectives(self) -> None:
+        """Layer-wise collective overlap (parallel/round.py +
+        ops/collectives/). Only the value set is validated here — the
+        knob is a pure collective-scheduling choice that composes with
+        every mode (paths without a chunkable collective trace the same
+        program as overlap='none')."""
+        if self.overlap_collectives not in ("none", "layerwise"):
+            raise ValueError(
+                "overlap_collectives must be 'none' (monolithic "
+                "aggregation collectives, the golden-pinned default) or "
+                "'layerwise' (segmented collectives issued as the "
+                f"backward produces them), got "
+                f"{self.overlap_collectives!r}"
+            )
+
     def _validate_scan_rounds(self) -> None:
         """Scan-over-rounds flags (pipeline/scan_engine.py). The engine
         executes K rounds per dispatch, so anything that must act
@@ -868,6 +919,13 @@ class Config:
                     "staleness_exponent has no effect without "
                     "--async_buffer K: synchronous rounds have staleness 0 "
                     "by construction"
+                )
+            if self.async_double_buffer:
+                raise ValueError(
+                    "async_double_buffer defers the asyncfed apply fence "
+                    "behind the next cohort launches, which only exist "
+                    "with --async_buffer K; set async_buffer > 0 to "
+                    "enable the asyncfed engine"
                 )
             return
         if self.async_buffer > self.num_workers:

@@ -87,6 +87,13 @@ TOLERANCES = {
     # trajectory under a stochastic straggler schedule, so it keeps the
     # default wider band (no entry)
     "sketch_async_vs_sync": 0.10,
+    # hidden-collectives PR: both overlap ratios divide two same-mesh
+    # measurements of the same program shape (load cancels), so they get
+    # the tight band — and gate UP: overlapped must not lose to
+    # sequential. The band makes the design claim trajectory-enforced,
+    # same pattern as sketch_async_vs_sync above.
+    "sketch_overlap_layerwise_vs_sequential": 0.10,
+    "async_double_buffered_vs_sequential": 0.10,
 }
 
 # pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated
@@ -104,7 +111,13 @@ HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
                              # (*_time_to_loss_sec itself stays
                              # informational — its ratio carries the gate)
                              "_updates_per_sec", "_rounds_per_sec",
-                             "_vs_sync")
+                             "_vs_sync",
+                             # hidden-collectives PR: overlapped vs
+                             # sequential twins — the ratio gates up
+                             # (*_exposed_collective_ms stays
+                             # informational: near-zero ms makes relative
+                             # bands meaningless, like *_host_stall_ms)
+                             "_vs_sequential")
 # resilience/control PRs: every *_retraces leg gauge is a hard invariant,
 # not a throughput — the AOT-prewarm contract says rung switches and
 # rollback restores never retrace, so ANY non-zero value fails outright

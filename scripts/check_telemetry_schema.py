@@ -68,8 +68,16 @@ from pathlib import Path
 # >= 0, effective_participation >= 0 — enforced below), perf_report
 # engine "async" with a REQUIRED {buffer, concurrency,
 # staleness_exponent} "async" block on async reports and the block
-# FORBIDDEN on synchronous ones. Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+# FORBIDDEN on synchronous ones; v9 (hidden-collectives PR): the
+# xla/exposed_collective_ms scalar (non-negative finite host gauge —
+# enforced below), spans events' optional args.collective tag + the
+# spans_*.json top-level exposed_collective_ms field, and perf_report's
+# "overlap" block {collectives: 'none'|'layerwise', double_buffer} —
+# REQUIRED when the report's config has a hiding mode on
+# (overlap_collectives != 'none' or async_double_buffer), FORBIDDEN when
+# both are off, and never all-off when present (enforced below). Older
+# artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
@@ -286,6 +294,27 @@ def _check_async_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_xla_scalar(name: str, v, where: str) -> None:
+    """v9 ``xla/exposed_collective_ms`` value invariant: a host-computed
+    cumulative gauge (interval arithmetic over the span recorder — never
+    legitimately non-finite, so the nan/inf markers are rejected) and
+    non-negative by construction: it measures un-overlapped collective
+    wait, and negative time means the writer's interval subtraction
+    broke."""
+    if name != "xla/exposed_collective_ms":
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if v < 0:
+        raise SchemaError(
+            f"{where}: xla/exposed_collective_ms {v} is negative — "
+            "exposed collective time is an interval measure, >= 0"
+        )
+
+
 def _check_recovery_history(hist, where: str) -> None:
     """v6 flight ``recovery_history`` block: one entry per divergence
     rollback, in recovery order."""
@@ -352,6 +381,7 @@ def validate_metrics_jsonl(path) -> int:
             _check_pipeline_scalar(name, rec["value"], where)
             _check_resilience_scalar(name, rec["value"], where)
             _check_async_scalar(name, rec["value"], where)
+            _check_xla_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
@@ -537,6 +567,7 @@ def validate_flight(path) -> dict:
             _check_pipeline_scalar(name, v, w)
             _check_resilience_scalar(name, v, w)
             _check_async_scalar(name, v, w)
+            _check_xla_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step
@@ -604,6 +635,47 @@ def validate_perf_report(path) -> dict:
         raise SchemaError(
             f"{where}: 'async' block present on a {engine!r} report — the "
             "overlap geometry is an async-engine property (schema v8)"
+        )
+    # v9: the collective-hiding block is required exactly when the
+    # report's config has a hiding mode on — wall-clock rows must always
+    # be attributable to their overlap setting, so a report silently
+    # produced under layerwise overlap (block missing) and one carrying a
+    # both-off block (mislabeled producer) are both hard errors
+    cfg_blk = rec.get("meta", {}).get("config") or {}
+    cfg_hiding = (cfg_blk.get("overlap_collectives", "none") != "none"
+                  or bool(cfg_blk.get("async_double_buffer", False)))
+    if "overlap" in rec:
+        blk = _req(rec, "overlap", dict, where)
+        ov = blk.get("collectives")
+        if ov not in ("none", "layerwise"):
+            raise SchemaError(
+                f"{where}:overlap: collectives must be 'none' or "
+                f"'layerwise', got {ov!r}"
+            )
+        db = blk.get("double_buffer")
+        if not isinstance(db, bool):
+            raise SchemaError(
+                f"{where}:overlap: double_buffer must be a bool, got {db!r}"
+            )
+        if ov == "none" and not db:
+            raise SchemaError(
+                f"{where}: 'overlap' block with every hiding mode off — "
+                "the block rides the report only when a mode is ON "
+                "(schema v9)"
+            )
+        if cfg_blk and not cfg_hiding:
+            raise SchemaError(
+                f"{where}: 'overlap' block present but the report's config "
+                "has overlap_collectives='none' and async_double_buffer "
+                "off — mislabeled producer (schema v9)"
+            )
+    elif cfg_hiding:
+        raise SchemaError(
+            f"{where}: config has a collective-hiding mode on "
+            f"(overlap_collectives="
+            f"{cfg_blk.get('overlap_collectives', 'none')!r}, "
+            f"async_double_buffer={cfg_blk.get('async_double_buffer')!r}) "
+            "but the report carries no 'overlap' block (schema v9)"
         )
     _check_header({**_req(rec, "meta", dict, where),
                    "schema_version": rec["schema_version"]}, where + ":meta")
@@ -710,6 +782,11 @@ def validate_spans(path) -> dict:
         raise SchemaError(
             f"{where}: kind must be 'spans', got {rec.get('kind')!r}"
         )
+    if "exposed_collective_ms" in rec:
+        # v9: the dump-level exposure figure (telemetry/spans.py
+        # collective_exposure_ms) — same gauge invariant as the scalar
+        _check_xla_scalar("xla/exposed_collective_ms",
+                          rec["exposed_collective_ms"], where)
     events = _req(rec, "traceEvents", list, where)
     if not events:
         raise SchemaError(f"{where}: empty traceEvents")
@@ -759,6 +836,13 @@ def validate_spans(path) -> dict:
             )
         args = _req(ev, "args", dict, w)
         _req(args, "step", int, w + ":args")
+        if "collective" in args and args["collective"] is not True:
+            # v9: the tag is only ever written as true (absent == false);
+            # any other value means a writer regression
+            raise SchemaError(
+                f"{w}: args.collective must be true when present, got "
+                f"{args['collective']!r}"
+            )
         n_spans += 1
     if n_spans == 0:
         raise SchemaError(f"{where}: no complete ('X') span events")

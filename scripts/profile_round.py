@@ -439,6 +439,34 @@ def main():
                   f"update: {dt_a:.2f} ms (launch amortized over "
                   f"~{-(-workers // K)} updates -> "
                   f"{dt_l / -(-workers // K) + dt_a:.2f} ms/update)")
+            # double-buffer twin (hide-the-collectives PR): the sequential
+            # engine fences each apply's loss before dispatching the next
+            # update; the double-buffered engine (--async_double_buffer)
+            # defers that fence one update, so update i+1 is already in
+            # XLA's queue while apply i's collectives run. The twin lines
+            # time the same apply chain under both fence disciplines — the
+            # delta is the host stall the deferred fence removes.
+            t0 = time.perf_counter()
+            for _ in range(r):
+                ast, m = apply_fn(ast, *out, ids, weights,
+                                  jnp.float32(workers), jnp.float32(0.1))
+                fence(m["loss"])  # per-update fence = sequential engine
+            dt_seq = (time.perf_counter() - t0) / r * 1e3
+            print(f"[async sequential] apply + per-update fence: "
+                  f"{dt_seq:.2f} ms/update")
+            prev = None
+            t0 = time.perf_counter()
+            for _ in range(r):
+                ast, m = apply_fn(ast, *out, ids, weights,
+                                  jnp.float32(workers), jnp.float32(0.1))
+                if prev is not None:
+                    fence(prev)  # drained AFTER the next apply dispatches
+                prev = m["loss"]
+            fence(prev)
+            dt_db = (time.perf_counter() - t0) / r * 1e3
+            print(f"[async double-buffer] apply + deferred fence: "
+                  f"{dt_db:.2f} ms/update (overlap delta "
+                  f"{dt_seq - dt_db:+.2f} ms/update)")
         except Exception as e:  # noqa: BLE001 — lab line, never kills the run
             print(f"[async] phase lines unavailable: {e}")
 
@@ -470,6 +498,34 @@ def main():
     dt_loop = (time.perf_counter() - t0) / n * 1e3
     print(f"per-round dispatch [{tag}]: {dt_loop:.2f} ms -> "
           f"{workers * bench_batch / dt_loop * 1e3:,.0f} samples/s")
+    # layerwise-overlap twin (hide-the-collectives PR): the same round
+    # with the aggregation psum and the top-k gathers split into
+    # per-leaf-group segments (--overlap_collectives layerwise) so XLA
+    # can run each segment's ring concurrently with the next segment's
+    # reduction work. The delta vs the sequential line above is the
+    # exposed-collective time the chunking hides (≈0 on a one-chip mesh
+    # — there is no cross-chip ring to hide there).
+    if args.mode == "sketch":
+        try:
+            ov_sess = FederatedSession(
+                cfg.replace(overlap_collectives="layerwise"),
+                params, loss_fn, mesh=make_mesh(1))
+            ov_fn = ov_sess.round_fn
+            ov_state = ov_sess.state
+            for _ in range(2):  # compile + warm both donated layouts
+                ov_state, m = ov_fn(ov_state, ids, data, jnp.float32(0.1))
+            fence(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ov_state, m = ov_fn(ov_state, ids, data, jnp.float32(0.1))
+            fence(m["loss"])
+            dt_ov = (time.perf_counter() - t0) / n * 1e3
+            print(f"[overlap layerwise] per-round dispatch: {dt_ov:.2f} ms "
+                  f"-> {workers * bench_batch / dt_ov * 1e3:,.0f} samples/s "
+                  f"(overlap delta vs sequential "
+                  f"{dt_loop - dt_ov:+.2f} ms/round)")
+        except Exception as e:  # noqa: BLE001 — lab line, never kills the run
+            print(f"[overlap layerwise] twin unavailable: {e}")
     state, losses = run_rounds(state)
     fence(losses)
     t0 = time.perf_counter()

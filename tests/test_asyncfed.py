@@ -398,3 +398,152 @@ def test_anchor_ledger_bills_exactly_the_sync_bytes(tmp_path):
         "buffer": 8, "concurrency": 1, "staleness_exponent": 0.0}
     assert reports["sync"]["engine"] == "replicated"
     assert "async" not in reports["sync"]
+
+
+# ---------------------------------------------------------------------------
+# double-buffered rounds (ISSUE 16): deferred fence, same bits
+# ---------------------------------------------------------------------------
+
+def _run_async_spans(cfg, tmp_path, num_rounds=N_ROUNDS, lr=0.3,
+                     ladder_rounds=None):
+    """_run_async with a live PhaseSpans attached to session AND engine —
+    the double-buffered fence discipline only executes with spans armed
+    (without them there is nothing to defer), so these tests must run it
+    for real."""
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.sampler_batch_size, seed=1)
+    if ladder_rounds:
+        from commefficient_tpu.control import build_controller
+
+        ctrl = build_controller(cfg, sess, num_rounds=ladder_rounds)
+        ctrl.prewarm(sampler, lr)
+    spans = PhaseSpans(str(tmp_path), start_step=2, num_steps=num_rounds)
+    sess.spans = spans
+    eng = AsyncFederation(cfg, sess, sampler, lambda s: lr, num_rounds,
+                          steps_per_epoch=num_rounds, spans=spans).start()
+    records = []
+    try:
+        for step, _lr, m in eng.epoch_rounds(0, 0):
+            records.append((step, m))
+    finally:
+        eng.close()
+    return sess, records, eng, spans
+
+
+@pytest.mark.parametrize("mode", [
+    pytest.param("uncompressed", marks=pytest.mark.slow),
+    "sketch",  # headline mode holds the default-tier pin (PR-12 precedent)
+])
+def test_double_buffer_anchor_bit_identical_to_sync(mode, tmp_path):
+    """The apply fence parks behind the next cohort's launches, but the
+    device programs dispatch in the same order — K=W, C=1, alpha=0 must
+    still reduce to the synchronous round bit for bit."""
+    extra = MODE_CONFIGS[mode]
+    sync_sess, sync_losses = _run_sync(Config(**extra, **BASE))
+    cfg = _anchor(dict(extra, async_double_buffer=True))
+    async_sess, records, eng, spans = _run_async_spans(cfg, tmp_path)
+    async_losses = [float(np.asarray(m["loss"])) for _, m in records]
+    assert async_losses == sync_losses
+    assert np.array_equal(np.asarray(async_sess.state.params_vec),
+                          np.asarray(sync_sess.state.params_vec)), \
+        f"{mode}: double-buffered anchor not bit-identical"
+    # the deferred discipline actually ran: applies record as dispatch
+    # spans (not collective-fenced applies) and the parked fences drained
+    names = [ev["name"] for ev in spans.events]
+    assert "async_apply_dispatch" in names
+    assert "async_apply_drain" in names
+    assert "async_apply" not in names, \
+        "double-buffer mode must not record sequential apply spans"
+    # drain spans are the collective-tagged ones
+    for ev in spans.events:
+        if ev["name"] == "async_apply_drain":
+            assert ev["args"].get("collective") is True
+        if ev["name"] == "async_apply_dispatch":
+            assert "collective" not in ev["args"]
+
+
+def test_double_buffer_close_drains_parked_fence(tmp_path):
+    """close() (and snapshot_extra) must drain the parked fence — the
+    last update's loss cannot stay un-synced past the engine's life."""
+    cfg = _anchor(dict(MODE_CONFIGS["uncompressed"],
+                       async_double_buffer=True))
+    _sess, records, eng, spans = _run_async_spans(cfg, tmp_path)
+    assert eng._deferred is None, "close() left a parked fence"
+    drains = [ev for ev in spans.events
+              if ev["name"] == "async_apply_drain"]
+    assert len(drains) == len(records), \
+        "every deferred apply fence must drain exactly once"
+
+
+def test_double_buffer_snapshot_restore_replays_bit_identically(tmp_path):
+    """The vault riders under double buffering: snapshot_extra drains the
+    parked fence first, and the restored in-flight window replays the
+    tail bit-identically — the rollback/recovery path stays exact."""
+    extra = dict(MODE_CONFIGS["uncompressed"], async_double_buffer=True)
+    cfg = Config(async_buffer=4, async_concurrency=2,
+                 staleness_exponent=0.5, arrival_rate=2.0, **extra, **BASE)
+    n, cut = 6, 3
+
+    ref_sess, ref_records, _, _ = _run_async_spans(
+        cfg, tmp_path / "ref", num_rounds=n)
+    ref_losses = [float(np.asarray(m["loss"])) for _, m in ref_records]
+
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.sampler_batch_size, seed=1)
+    spans = PhaseSpans(str(tmp_path / "cut"), start_step=2, num_steps=n)
+    sess.spans = spans
+    eng = AsyncFederation(cfg, sess, sampler, lambda s: 0.3, n,
+                          steps_per_epoch=n, spans=spans).start()
+    losses = []
+    try:
+        for step, _lr, m in eng.epoch_rounds(0, 0):
+            losses.append(float(np.asarray(m["loss"])))
+            if step == cut - 1:
+                break
+        blob = eng.snapshot_extra()
+        assert eng._deferred is None, "snapshot_extra left a parked fence"
+        eng.restore_extra(blob)
+        eng.restart(cut)
+        for step, _lr, m in eng.epoch_rounds(0, cut):
+            losses.append(float(np.asarray(m["loss"])))
+    finally:
+        eng.close()
+    assert losses == ref_losses
+    assert np.array_equal(np.asarray(sess.state.params_vec),
+                          np.asarray(ref_sess.state.params_vec))
+
+
+def test_double_buffer_zero_retraces_across_rung_switches(tmp_path):
+    """A mid-run ladder switch quiesces the window and recompiles the
+    rung's launch/apply pair ONCE; the deferred fence must neither leak
+    across the switch nor force extra retraces. telemetry_level=1 also
+    exercises the new xla/exposed_collective_ms scalar end-to-end."""
+    n = 6
+    cfg = Config(async_buffer=8, async_concurrency=1,
+                 staleness_exponent=0.0, async_double_buffer=True,
+                 mode="local_topk", error_type="local",
+                 topk_method="threshold", telemetry_level=1,
+                 control_policy="fixed", control_schedule="0-2=0,3-=1",
+                 ladder="k=20,10", **BASE)
+    sess, records, eng, spans = _run_async_spans(
+        cfg, tmp_path, num_rounds=n, ladder_rounds=n)
+    assert len(records) == n
+    for _, m in records:
+        assert np.isfinite(float(np.asarray(m["loss"])))
+    assert eng.quiesces == 1, "the ladder switch must quiesce the window"
+    assert sess.retrace_sentinel.retraces == 0, \
+        "double buffering must not add retraces across rung switches"
+    rungs = [float(np.asarray(m["control/rung"])) for _, m in records]
+    assert rungs == [0, 0, 0, 1, 1, 1]
+    # the v9 scalar rides the metrics whenever spans are armed
+    for _, m in records:
+        assert float(np.asarray(m["xla/retraces"])) == 0
+        assert float(m["xla/exposed_collective_ms"]) >= 0.0

@@ -318,3 +318,47 @@ def test_async_metrics_registered_and_gated(tmp_path):
         [good], {**good, "sketch_async_retraces": 1})
     assert [r["metric"] for r in regs] == ["sketch_async_retraces"]
     assert regs[0]["direction"] == "exact_zero"
+
+
+def test_overlap_metrics_registered_and_gated(tmp_path):
+    """Hide-the-collectives PR: the overlap twin legs gate on their
+    _vs_sequential ratios (higher is better, tight 10% band — twin runs
+    of one geometry on one host, load cancels); the exposure/stall
+    millisecond rows and the skip markers stay informational (near-zero
+    ms readings are noise, not a gate)."""
+    mod = _gate()
+    for name in ("sketch_overlap_layerwise_vs_sequential",
+                 "async_double_buffered_vs_sequential",
+                 "sketch_overlap_layerwise_samples_per_sec",
+                 "async_double_buffered_updates_per_sec"):
+        assert mod.metric_direction(name) == "up"
+    assert mod.tolerance_for("sketch_overlap_layerwise_vs_sequential",
+                             0.15) == 0.10
+    assert mod.tolerance_for("async_double_buffered_vs_sequential",
+                             0.15) == 0.10
+    for name in ("async_double_buffered_exposed_collective_ms",
+                 "async_sequential_exposed_collective_ms",
+                 "async_double_buffered_host_stall_ms",
+                 "sketch_overlap_layerwise_skipped",
+                 "async_double_buffered_skipped",
+                 "sketch_overlap_error"):
+        assert mod.metric_direction(name) is None
+    # detects-regression self-test: the overlap advantage collapsing
+    # below median * (1 - 0.10) must gate and name both ratios
+    good = {**BASELINE, "sketch_overlap_layerwise_vs_sequential": 1.10,
+            "async_double_buffered_vs_sequential": 1.20}
+    bad = {**BASELINE, "sketch_overlap_layerwise_vs_sequential": 0.95,
+           "async_double_buffered_vs_sequential": 1.00}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", bad)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _, _ = mod.check_regression([good], bad)
+    assert {r["metric"] for r in regs} == {
+        "sketch_overlap_layerwise_vs_sequential",
+        "async_double_buffered_vs_sequential"}
+    assert all(r["direction"] == "up" for r in regs)
+    # within the band passes
+    regs, _, _ = mod.check_regression(
+        [good], {**good, "sketch_overlap_layerwise_vs_sequential": 1.05,
+                 "async_double_buffered_vs_sequential": 1.12})
+    assert regs == []

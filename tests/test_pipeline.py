@@ -577,7 +577,9 @@ def _scalar_sequence(logdir, *, exclude_prefix="pipeline/"):
     """Every scalar record under ``logdir`` as (name, value, step) tuples
     in file order — the bit-exactness comparison unit (wall-time ``t`` is
     the only field that may differ between twin runs). ``pipeline/*`` is
-    excluded: those gauges exist only at depth > 0 by design."""
+    excluded: those gauges exist only at depth > 0 by design, and
+    ``xla/exposed_collective_ms`` (v9) because it is the stream's one
+    host-measured wall-clock scalar."""
     out = []
     for root, _, files in os.walk(logdir):
         for f in sorted(files):
@@ -588,7 +590,9 @@ def _scalar_sequence(logdir, *, exclude_prefix="pipeline/"):
                     rec = json.loads(line)
                     if "name" not in rec:
                         continue  # run header
-                    if rec["name"].startswith(exclude_prefix):
+                    if rec["name"].startswith(
+                        (exclude_prefix, "xla/exposed_collective_ms")
+                    ):
                         continue
                     out.append((rec["name"], rec["value"], rec["step"]))
     return out

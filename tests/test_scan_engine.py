@@ -160,8 +160,12 @@ def _scalar_sequence(logdir):
                     rec = json.loads(line)
                     if "name" not in rec:
                         continue
-                    if rec["name"].startswith("pipeline/"):
-                        continue  # scan gauges exist only at K > 1
+                    if rec["name"].startswith(
+                        ("pipeline/", "xla/exposed_collective_ms")
+                    ):
+                        # scan gauges exist only at K > 1; the exposure
+                        # scalar (v9) is wall-clock, never bit-equal
+                        continue
                     out.append((rec["name"], rec["value"], rec["step"]))
     return out
 

@@ -640,3 +640,139 @@ def test_v8_perf_report_async_block_required_and_forbidden(tmp_path):
     # forbidden direction: the block riding a synchronous report
     tampered(lambda r: r.update(engine="replicated"),
              "present on a 'replicated' report")
+
+
+# ---------------------------------------------------------------------------
+# v9: the exposed-collective gauge + the perf-report overlap block
+# ---------------------------------------------------------------------------
+
+def test_v9_exposed_collective_scalar_validates_and_rejects(tmp_path):
+    """xla/exposed_collective_ms through the REAL writer validates; the
+    gauge invariant (finite, >= 0) rejects every tampering direction."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("xla/exposed_collective_ms", 0.25 * s, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    mod.validate_metrics_jsonl(path)
+
+    lines = open(path).read().splitlines()
+    for bad_rec, msg in [
+        ({"name": "xla/exposed_collective_ms", "value": -0.5, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "xla/exposed_collective_ms", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+        ({"name": "xla/exposed_collective_ms", "value": True, "step": 0,
+          "t": 1.0}, "neither a number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(lines[0] + "\n" + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def test_v9_spans_collective_tag_and_exposure_field(tmp_path):
+    """A REAL spans dump with collective-tagged spans validates and
+    carries the dump-level exposure figure; the checker rejects a false
+    tag and a negative exposure."""
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    mod = _checker()
+    spans = PhaseSpans(str(tmp_path))
+    spans.step(2)
+    with spans.span("round_dispatch", collective=True):
+        pass
+    with spans.span("data_load"):
+        pass
+    path = spans.close()
+    rec = mod.validate_spans(path)
+    assert rec["exposed_collective_ms"] >= 0.0
+    tagged = [e for e in rec["traceEvents"]
+              if e["ph"] == "X" and e["args"].get("collective")]
+    assert len(tagged) == 1 and tagged[0]["name"] == "round_dispatch"
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_spans.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_spans(bad)
+
+    tampered(lambda r: r["traceEvents"][0]["args"].update(collective=False),
+             "args.collective must be true")
+    tampered(lambda r: r["traceEvents"][0]["args"].update(collective=1),
+             "args.collective must be true")
+    tampered(lambda r: r.update(exposed_collective_ms=-1.0), "negative")
+    tampered(lambda r: r.update(exposed_collective_ms="nan"),
+             "finite number")
+
+
+def test_v9_perf_report_overlap_block_required_and_forbidden(tmp_path):
+    """A REAL layerwise-overlap audit report validates with its v9
+    overlap block; the checker rejects every mislabeling direction —
+    config on without the block, block with config off, all-off block,
+    and malformed fields."""
+    mod = _checker()
+    path = _write_perf_report(tmp_path, overlap_collectives="layerwise")
+    rec = mod.validate_perf_report(path)
+    assert rec["overlap"] == {"collectives": "layerwise",
+                              "double_buffer": False}
+    assert rec["meta"]["config"]["overlap_collectives"] == "layerwise"
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_report.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_perf_report(bad)
+
+    # required direction: hiding mode on in config, block missing
+    tampered(lambda r: r.pop("overlap"), "no 'overlap' block")
+    # malformed fields
+    tampered(lambda r: r["overlap"].update(collectives="bogus"),
+             "'none' or 'layerwise'")
+    tampered(lambda r: r["overlap"].update(double_buffer=1),
+             "must be a bool")
+    # an all-off block is a writer bug (the block exists to mark runs
+    # whose wall-clock is overlap-dependent)
+    tampered(lambda r: (r["overlap"].update(collectives="none"),
+                        r["meta"]["config"].update(
+                            overlap_collectives="none")),
+             "every hiding mode off")
+    # forbidden direction: block riding a config with hiding off
+    tampered(lambda r: r["meta"]["config"].update(
+        overlap_collectives="none"),
+        "config has overlap_collectives='none'")
+
+
+def test_v9_report_without_hiding_modes_has_no_overlap_block(tmp_path):
+    """The default round's report stays block-free (v8 shape), and a v8
+    artifact — config predating the overlap keys entirely — still
+    validates."""
+    mod = _checker()
+    path = _write_perf_report(tmp_path)
+    rec = mod.validate_perf_report(path)
+    assert "overlap" not in rec
+    assert rec["meta"]["config"]["overlap_collectives"] == "none"
+
+    # a genuine v8 artifact: no overlap keys in config at all
+    with open(path) as f:
+        r = json.load(f)
+    r["schema_version"] = 8
+    r["meta"]["config"].pop("overlap_collectives")
+    r["meta"]["config"].pop("async_double_buffer")
+    old = os.path.join(str(tmp_path), "v8_report.json")
+    with open(old, "w") as f:
+        json.dump(r, f)
+    mod.validate_perf_report(old)
