@@ -606,7 +606,7 @@ def _measure_sparse_agg(base, n_rounds: int = 10) -> dict:
     B = base.local_batch_size
     for mode, extra in (
         ("local_topk", dict(error_type="local", virtual_momentum=0.0,
-                            fuse_clients=False, offload_client_state=True)),
+                            fuse_clients=False, client_store="host")),
         ("true_topk", dict(error_type="virtual", virtual_momentum=0.9)),
     ):
         twin_cfg = base.replace(
@@ -640,19 +640,126 @@ def _measure_sparse_agg(base, n_rounds: int = 10) -> dict:
                     mesh=make_mesh(n_dev),
                 )
                 state, round_fn = session.state, session.round_fn
-                for _ in range(3):  # compile + donated-layout warmup
+                # hosted banks (clientstore/): the round takes the
+                # cohort's rows as donated arguments and returns the
+                # updated ones — thread them through the timing loop so
+                # the bank writeback stays off the measured path
+                hosted = session._streamer is not None
+                vel = err = ()
+                if hosted:
+                    cohort = session._streamer.gather(np.asarray(ids))
+                    vel, err = cohort.vel, cohort.err
+
+                def step(state, vel, err):
+                    if hosted:
+                        return round_fn(state, ids, data, jnp.float32(0.1),
+                                        vel, err)
                     state, m = round_fn(state, ids, data, jnp.float32(0.1))
+                    return state, m, vel, err
+
+                for _ in range(3):  # compile + donated-layout warmup
+                    state, m, vel, err = step(state, vel, err)
                     assert np.isfinite(fence(m["loss"]))
                 t0 = time.perf_counter()
                 for _ in range(n_rounds):
-                    state, m = round_fn(state, ids, data, jnp.float32(0.1))
+                    state, m, vel, err = step(state, vel, err)
                 assert np.isfinite(fence(m["loss"]))
                 dt = time.perf_counter() - t0
                 sps[agg] = n_rounds * n_dev * B / dt
+                if hosted:
+                    session.close_client_store()
             out[name] = round(sps["sparse"], 2)
             out[f"{name}_vs_dense"] = round(sps["sparse"] / sps["dense"], 3)
         except Exception as e:  # noqa: BLE001 — per-leg error isolation
             out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
+    return out
+
+
+def _measure_hostclient(base, n_rounds: int = 10) -> dict:
+    """clientstore PR: the hosted round (per-client vel/err banks in host
+    RAM, cohort rows streamed per round) vs its device-resident twin on
+    the SAME mesh and round shape. The ``_vs_device`` ratio (host sps /
+    device sps, higher is better — registered in
+    scripts/check_bench_regression.py) is the leg's design claim: with
+    the cohort gather staged H2D and the writeback async, hosting the
+    [C, D] banks must not cost the round loop more than noise — while
+    bounding C by host RAM/disk instead of HBM (the C = 1e6 smoke in
+    tests/test_clientstore.py). Sliding cohorts (overlap W-1 per round)
+    exercise the LRU device cache, whose hit rate and H2D stage time ride
+    along as informational gauges; the retrace gauge is the hard zero."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.profiling import fence
+
+    n_dev = len(jax.devices())
+    out: dict = {}
+    B = base.local_batch_size
+    C = 4 * n_dev
+    twin = base.replace(
+        mode="local_topk", error_type="local", local_momentum=0.9,
+        virtual_momentum=0.0, fuse_clients=False, k=50_000,
+        topk_method="threshold", num_devices=n_dev, num_workers=n_dev,
+        num_clients=C, telemetry_level=1,
+    )
+    name = "local_topk_hostclient"
+    try:
+        model = ResNet9(num_classes=10, dtype=model_dtype(twin.compute_dtype))
+        params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+        loss_fn = classification_loss(
+            model.apply, compute_dtype=twin.compute_dtype
+        )
+        rng = np.random.default_rng(0)
+        data = {
+            "x": jnp.asarray(
+                rng.normal(size=(n_dev, B, 32, 32, 3)).astype(np.float32)
+            ),
+            "y": jnp.asarray(
+                rng.integers(0, 10, size=(n_dev, B)).astype(np.int32)
+            ),
+        }
+        sps, gauges = {}, {}
+        for store in ("device", "host"):
+            cfg = twin.replace(
+                client_store=store,
+                client_store_cache_rows=2 * n_dev if store == "host" else 0,
+            )
+            session = FederatedSession(cfg, params, loss_fn,
+                                       mesh=make_mesh(n_dev))
+
+            def one_round(r):
+                # sliding cohort: W-1 clients repeat from round r-1, so
+                # the device cache sees real hits AND real evictions
+                ids = (np.arange(n_dev, dtype=np.int32) + r) % C
+                return session.train_round(ids, data, 0.1)
+
+            for r in range(3):  # compile + donated-layout warmup
+                m = one_round(r)
+                assert np.isfinite(fence(m["loss"]))
+            hit = h2d = 0.0
+            t0 = time.perf_counter()
+            for r in range(3, 3 + n_rounds):
+                m = one_round(r)
+                hit += float(m.get("clientstore/cache_hit_rate", 0.0))
+                h2d += float(m.get("clientstore/h2d_stage_ms", 0.0))
+            assert np.isfinite(fence(m["loss"]))
+            dt = time.perf_counter() - t0
+            sps[store] = n_rounds * n_dev * B / dt
+            if store == "host":
+                gauges = {
+                    f"{name}_cache_hit_rate": round(hit / n_rounds, 3),
+                    f"{name}_h2d_stage_ms": round(h2d / n_rounds, 3),
+                    f"{name}_retraces": session.retrace_sentinel.retraces,
+                }
+                session.close_client_store()
+        out[f"{name}_samples_per_sec"] = round(sps["host"], 2)
+        out[f"{name}_vs_device"] = round(sps["host"] / sps["device"], 3)
+        out.update(gauges)
+    except Exception as e:  # noqa: BLE001 — per-leg error isolation
+        out[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
     return out
 
 
@@ -1033,6 +1140,18 @@ def main():
         else:
             rows.update(sa)
             print(json.dumps({"metric": "sparse_agg", **sa}))
+        # clientstore PR: the host-resident client-state round vs its
+        # device-resident twin (per-leg error isolation happens inside)
+        try:
+            hc = _measure_hostclient(base)
+        except Exception as e:  # noqa: BLE001
+            rows["local_topk_hostclient_error"] = \
+                f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "local_topk_hostclient",
+                              "error": rows["local_topk_hostclient_error"]}))
+        else:
+            rows.update(hc)
+            print(json.dumps({"metric": "local_topk_hostclient", **hc}))
         # asyncfed PR: the buffered-async engine vs its synchronous twin
         # under ~40% poisson stragglers — server-update rate, time to the
         # sync twin's final loss, and the hard-zero retrace invariant

@@ -1,0 +1,51 @@
+"""clientstore/ — host-resident per-client state, streamed per cohort.
+
+FetchSGD's local-momentum/error banks are logically ``[num_clients, D]``
+but each round only touches the W participants' rows. With
+``--client_store device`` (the default) the banks stay device arrays
+inside FedState and this package constructs NOTHING — the
+telemetry_level-0 discipline, golden parity bit-untouched. With
+``--client_store host|mmap`` the banks live in a ``store.py`` bank
+(host RAM / a memory-mapped file), cohort rows stream to device through
+the ``CohortStreamer`` (optionally fronted by the ``cache.py`` LRU
+device cache) and write back asynchronously after the drain fence —
+so C is bounded by host DRAM or disk instead of HBM, the compiled
+round's HLO carries no [C, D]-scale gather, and the strict O(W·k)
+sparse-aggregate bound holds with no exemption (README "Host-resident
+client state").
+
+Layering: stdlib + numpy (jax only inside the device store / staged
+assembly, never at import). ``parallel/`` builds the streamer;
+``utils/config.py`` mirrors the registry kinds as ``CLIENT_STORES``
+(pinned equal by tests/test_clientstore.py).
+"""
+
+from commefficient_tpu.clientstore.cache import LRURowCache
+from commefficient_tpu.clientstore.store import (
+    ClientStateStore,
+    DeviceStore,
+    HostStore,
+    MmapStore,
+    available_stores,
+    build_store,
+    register,
+)
+from commefficient_tpu.clientstore.streamer import (
+    CohortStreamer,
+    StagedCohort,
+    build_streamer,
+)
+
+__all__ = [
+    "ClientStateStore",
+    "CohortStreamer",
+    "DeviceStore",
+    "HostStore",
+    "LRURowCache",
+    "MmapStore",
+    "StagedCohort",
+    "available_stores",
+    "build_store",
+    "build_streamer",
+    "register",
+]

@@ -1,0 +1,87 @@
+"""LRU row cache — hot cohort rows short-circuit the store round-trip.
+
+Availability models make some clients far more frequent than others
+(fedsim's cohort/sine/poisson draws), so a small device-resident working
+set of hot rows skips both the host bank read (disk pages under the mmap
+store) and the H2D stage for cache hits. The cache is value-agnostic —
+the streamer caches device arrays, the unit tests cache numpy rows — and
+owns exactly the bookkeeping:
+
+  * LRU order with a hard row capacity;
+  * write-through-on-eviction: a DIRTY row leaving the cache is handed
+    to the ``writeback(cid, row)`` callback before it is dropped, so the
+    backing bank is always the union of (clean bank rows, dirty cached
+    rows) — never silently behind;
+  * hit/miss/eviction counters for the ``clientstore/*`` telemetry.
+
+Not thread-safe by itself: the CohortStreamer serializes access under
+its own lock.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class LRURowCache:
+    """Keyed by client id; ``get`` counts and refreshes recency,
+    ``put`` inserts/overwrites and evicts least-recently-used rows past
+    capacity (writing dirty evictees through to ``writeback``)."""
+
+    def __init__(self, capacity: int, writeback):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._writeback = writeback
+        self._rows: OrderedDict = OrderedDict()  # cid -> row
+        self._dirty: set = set()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __contains__(self, cid) -> bool:
+        return cid in self._rows
+
+    def get(self, cid):
+        """The row, or None on a miss. Counts, and marks cid
+        most-recently-used on a hit."""
+        row = self._rows.get(cid)
+        if row is None:
+            self.misses += 1
+            return None
+        self._rows.move_to_end(cid)
+        self.hits += 1
+        return row
+
+    def put(self, cid, row, dirty: bool = True) -> None:
+        """Insert/overwrite cid's row (most-recently-used), then evict
+        past capacity — dirty evictees write through first."""
+        self._rows[cid] = row
+        self._rows.move_to_end(cid)
+        if dirty:
+            self._dirty.add(cid)
+        else:
+            self._dirty.discard(cid)
+        while len(self._rows) > self.capacity:
+            old_cid, old_row = self._rows.popitem(last=False)
+            self.evictions += 1
+            if old_cid in self._dirty:
+                self._dirty.discard(old_cid)
+                self._writeback(old_cid, old_row)
+
+    def flush(self) -> None:
+        """Write every dirty row through; rows stay cached (clean)."""
+        for cid in [c for c in self._rows if c in self._dirty]:
+            self._writeback(cid, self._rows[cid])
+        self._dirty.clear()
+
+    def invalidate(self) -> None:
+        """Drop everything WITHOUT writeback — after an external bank
+        load (checkpoint restore / vault rollback) cached rows are
+        stale, and writing them back would resurrect the rolled-back
+        state."""
+        self._rows.clear()
+        self._dirty.clear()

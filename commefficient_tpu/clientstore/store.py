@@ -1,0 +1,212 @@
+"""Pluggable per-client state stores — where the [num_clients, D] rows live.
+
+The paper multiplexes thousands of virtual clients onto few workers
+(FetchSGD, arXiv:2007.07682), so per-client momentum/error banks scale
+with C while each round only ever touches the W participants' rows. A
+``ClientStateStore`` owns one such bank OUTSIDE the traced graph and
+exposes exactly the cohort view the round needs:
+
+  * ``gather_rows(ids) -> [n, D]``  — the cohort's rows, a float32 copy
+    safe to stage H2D while the bank keeps mutating;
+  * ``scatter_rows(ids, rows)``     — write the round's updated rows back
+    (duplicate ids: last occurrence wins, numpy fancy-index semantics —
+    the same contract the whole-store offload path had).
+
+Three registered kinds behind the compress/-style registry
+(``--client_store``, mirrored by ``utils.config.CLIENT_STORES``):
+
+  * ``device`` — today's in-FedState device arrays. A session
+    configured with it constructs NO store (the telemetry_level-0
+    discipline: golden parity holds by construction); the registered
+    class exists so the contract tests cover all three kinds.
+  * ``host``   — a resident numpy bank: C bounded by host DRAM, not HBM.
+  * ``mmap``   — the same contract over ``np.memmap``: C bounded by
+    disk, and only the touched cohort pages ever materialize in RAM —
+    the C=1M-on-one-chip path. A named ``path`` persists across reopen
+    (``flush()`` + reopen gathers the written rows back).
+
+Layering: stdlib + numpy only, except the device store's jax import at
+construction (never at module import — this module must stay importable
+from the checker scripts without jax).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+REGISTRY: dict = {}
+
+
+def register(name: str):
+    """Class decorator: register a store kind (compress/ registry idiom)."""
+
+    def deco(cls):
+        if name in REGISTRY:
+            raise ValueError(f"duplicate client store {name!r}")
+        REGISTRY[name] = cls
+        cls.kind = name
+        return cls
+
+    return deco
+
+
+def available_stores() -> tuple:
+    """Registered store kinds — pinned equal to config.CLIENT_STORES by
+    tests/test_clientstore.py (the MODES no-cycle pattern)."""
+    return tuple(sorted(REGISTRY))
+
+
+def build_store(kind: str, *, num_rows: int, row_dim: int,
+                path: str = "") -> "ClientStateStore":
+    if kind not in REGISTRY:
+        raise ValueError(
+            f"unknown client store {kind!r}; available: {available_stores()}"
+        )
+    return REGISTRY[kind](num_rows=num_rows, row_dim=row_dim, path=path)
+
+
+class ClientStateStore:
+    """The store contract. Banks start zero-filled (the same init state
+    the device-resident ``jnp.zeros([C, D])`` leaves have), rows are
+    float32 throughout."""
+
+    kind = "abstract"
+
+    def __init__(self, *, num_rows: int, row_dim: int, path: str = ""):
+        if num_rows < 1 or row_dim < 1:
+            raise ValueError(
+                f"store shape must be positive, got [{num_rows}, {row_dim}]"
+            )
+        self.num_rows = int(num_rows)
+        self.row_dim = int(row_dim)
+
+    # -- the cohort contract -------------------------------------------
+    def gather_rows(self, ids) -> np.ndarray:
+        """[len(ids), row_dim] float32 COPY of the cohort's rows."""
+        raise NotImplementedError
+
+    def scatter_rows(self, ids, rows) -> None:
+        """Write rows back at ids (last duplicate wins)."""
+        raise NotImplementedError
+
+    # -- whole-bank access (checkpoint / rollback vault) ---------------
+    def array(self) -> np.ndarray:
+        """The [num_rows, row_dim] bank. May be a live view — callers
+        that need a stable snapshot copy (the vault already does)."""
+        raise NotImplementedError
+
+    def load(self, arr) -> None:
+        """Overwrite the whole bank (checkpoint restore / vault
+        rollback)."""
+        a = np.asarray(arr, dtype=np.float32)
+        if a.shape != (self.num_rows, self.row_dim):
+            raise ValueError(
+                f"bank shape mismatch: store is "
+                f"[{self.num_rows}, {self.row_dim}], got {a.shape}"
+            )
+        self.array()[...] = a
+
+    def flush(self) -> None:
+        """Persist pending writes (mmap); no-op for resident banks."""
+
+    def close(self) -> None:
+        """Release backing resources; the store is unusable after."""
+
+
+@register("host")
+class HostStore(ClientStateStore):
+    """Resident numpy bank — host RAM bounds C. The whole-store offload
+    path's ``np.zeros([C, D])`` bank, behind the cohort contract."""
+
+    def __init__(self, *, num_rows: int, row_dim: int, path: str = ""):
+        super().__init__(num_rows=num_rows, row_dim=row_dim, path=path)
+        self._bank = np.zeros((num_rows, row_dim), np.float32)
+
+    def gather_rows(self, ids) -> np.ndarray:
+        return self._bank[np.asarray(ids)]  # fancy indexing copies
+
+    def scatter_rows(self, ids, rows) -> None:
+        self._bank[np.asarray(ids)] = np.asarray(rows, dtype=np.float32)
+
+    def array(self) -> np.ndarray:
+        return self._bank
+
+
+@register("mmap")
+class MmapStore(ClientStateStore):
+    """Memory-mapped bank — disk bounds C, and only the cohort's touched
+    pages materialize in RAM (a zero-filled [1M, D] bank is a sparse
+    file until written). An explicit ``path`` reopens existing content
+    (persistence across restarts); "" uses an unlinked temp file."""
+
+    def __init__(self, *, num_rows: int, row_dim: int, path: str = ""):
+        super().__init__(num_rows=num_rows, row_dim=row_dim, path=path)
+        self._owns_file = not path
+        if not path:
+            fd, path = tempfile.mkstemp(prefix="clientstore_", suffix=".bank")
+            os.close(fd)
+        self.path = path
+        nbytes = num_rows * row_dim * 4
+        reopen = os.path.exists(path) and os.path.getsize(path) == nbytes
+        # r+ keeps existing content; w+ creates/zero-truncates (sparse)
+        self._bank = np.memmap(path, dtype=np.float32,
+                               mode="r+" if reopen else "w+",
+                               shape=(num_rows, row_dim))
+
+    def gather_rows(self, ids) -> np.ndarray:
+        return np.asarray(self._bank[np.asarray(ids)], dtype=np.float32)
+
+    def scatter_rows(self, ids, rows) -> None:
+        self._bank[np.asarray(ids)] = np.asarray(rows, dtype=np.float32)
+
+    def array(self) -> np.ndarray:
+        return self._bank
+
+    def flush(self) -> None:
+        self._bank.flush()
+
+    def close(self) -> None:
+        bank, self._bank = self._bank, None
+        if bank is not None:
+            bank.flush()
+            del bank  # drop the mmap before unlinking (windows-safe habit)
+        if self._owns_file and os.path.exists(self.path):
+            os.unlink(self.path)
+
+
+@register("device")
+class DeviceStore(ClientStateStore):
+    """The HBM-resident kind. A hosted session NEVER constructs this —
+    ``client_store='device'`` keeps the [C, D] leaves inside FedState and
+    builds nothing clientstore-related (bit-untouched golden parity).
+    Registered so the store contract is testable uniformly across every
+    ``--client_store`` value."""
+
+    def __init__(self, *, num_rows: int, row_dim: int, path: str = ""):
+        super().__init__(num_rows=num_rows, row_dim=row_dim, path=path)
+        import jax.numpy as jnp  # deferred: keep module import jax-free
+
+        self._jnp = jnp
+        self._bank = jnp.zeros((num_rows, row_dim), jnp.float32)
+
+    def gather_rows(self, ids) -> np.ndarray:
+        return np.asarray(self._bank[np.asarray(ids)], dtype=np.float32)
+
+    def scatter_rows(self, ids, rows) -> None:
+        self._bank = self._bank.at[np.asarray(ids)].set(
+            self._jnp.asarray(np.asarray(rows, dtype=np.float32)))
+
+    def array(self) -> np.ndarray:
+        return np.asarray(self._bank)
+
+    def load(self, arr) -> None:
+        a = np.asarray(arr, dtype=np.float32)
+        if a.shape != (self.num_rows, self.row_dim):
+            raise ValueError(
+                f"bank shape mismatch: store is "
+                f"[{self.num_rows}, {self.row_dim}], got {a.shape}"
+            )
+        self._bank = self._jnp.asarray(a)

@@ -17,8 +17,8 @@ worker aggregation, and the server update — is ONE jitted function over a
   * per-client state rows -> ``[num_clients, D]`` arrays gathered/scattered
                              for the round's participants at the jit top level,
                              or host-resident rows when
-                             ``cfg.offload_client_state`` (GPT-2 scale: W*D
-                             crosses PCIe per round instead of holding
+                             ``--client_store host|mmap`` (clientstore/:
+                             W*D crosses PCIe per round instead of holding
                              num_clients*D in HBM)
   * server momentum/error -> dense ``[D]`` vectors or ``[r, c]`` sketch tables
                              carried in ``FedState``
@@ -100,7 +100,7 @@ class FedState(NamedTuple):
     params_vec: jnp.ndarray  # [D] — the ps_weights analog
     momentum: Any = ()  # [D] dense | [r, c] sketch table | ()
     error: Any = ()  # [D] dense | [r, c] sketch table | ()
-    client_vel: Any = ()  # [num_clients, D] | () (host-side when offloaded)
+    client_vel: Any = ()  # [num_clients, D] | () (clientstore/ when hosted)
     client_err: Any = ()  # [num_clients, D] | ()
     step: jnp.ndarray = None  # scalar int32
     comp: Any = ()  # compressor-private warm state (powersgd's Q) | ()
@@ -150,15 +150,15 @@ def init_state(cfg: Config, params_vec: jnp.ndarray, spec: Optional[CountSketch]
     needs — the analog of FedModel.__init__'s conditional shm allocation
     (fed_aggregator.py ~L60-130); shapes come from the compressor's
     ``server_state_kinds``/``init_server_state``. Client rows are allocated
-    here only when NOT offloaded to host (see FederatedSession for the
-    offloaded path)."""
+    here only when device-resident (``--client_store device``); hosted
+    stores build a clientstore/ bank in FederatedSession instead."""
     d = params_vec.shape[0]
     f32 = jnp.float32
     comp = get_compressor(cfg, d=d, spec=spec)
     momentum, error, extra = comp.init_server_state()
     client_vel: Any = ()
     client_err: Any = ()
-    if not cfg.offload_client_state:
+    if not cfg.client_state_hosted:
         if needs_client_vel(cfg):
             client_vel = jnp.zeros((cfg.num_clients, d), f32)
         if needs_client_err(cfg):
@@ -737,11 +737,13 @@ def build_round_fn(
       With HBM-resident client state (default):
         ``round_fn(state, client_ids [W], batch {k: [W, ...]}, lr) ->
         (new_state, metrics)`` — jitted, donates ``state``.
-      With ``cfg.offload_client_state``:
+      With ``--client_store host|mmap`` (cfg.client_state_hosted):
         ``round_fn(state, client_ids, batch, lr, vel_rows [W,D]|(),
         err_rows [W,D]|()) -> (new_state, metrics, new_vel, new_err)`` —
-        the caller owns the [num_clients, D] store (host RAM) and
-        gathers/scatters the participants' rows around each call.
+        the [num_clients, D] banks live in a clientstore/ store (host
+        RAM or a memory-mapped file, NOT in FedState) and the session's
+        CohortStreamer gathers/scatters the participants' rows around
+        each call, so the compiled round never sees a [C, D] operand.
     """
     if d is None:
         raise ValueError(
@@ -938,7 +940,7 @@ def build_round_fn(
                 )
             live_mask, corrupt, live_count = env
             fs = (live_mask, corrupt)
-        if not cfg.offload_client_state:
+        if not cfg.client_state_hosted:
             vel_rows = (
                 state.client_vel[client_ids] if lm > 0 else jnp.zeros((W, 1), f32)
             )
@@ -967,7 +969,7 @@ def build_round_fn(
             count=live_count if use_fedsim else None,
             client_err_rows=new_err,
         )
-        if cfg.offload_client_state:
+        if cfg.client_state_hosted:
             new_state = FedState(
                 new_params, new_m, new_e, (), (), state.step + 1, new_comp
             )
@@ -990,7 +992,7 @@ def build_round_fn(
         # raw traceable round for callers that wrap it in a larger jitted
         # program (the device-resident-data path in FederatedSession)
         return round_fn
-    if cfg.offload_client_state:
+    if cfg.client_state_hosted:
         return jax.jit(round_fn, donate_argnums=(0, 4, 5))
     return jax.jit(round_fn, donate_argnums=(0,))
 

@@ -185,7 +185,8 @@ class PipelinedRounds:
                 work.client_ids, work.idx, work.plan, work.lr, env=work.env
             )
         return sess.train_round(
-            work.client_ids, work.batch, work.lr, env=work.env
+            work.client_ids, work.batch, work.lr, env=work.env,
+            cohort=work.cohort,
         )
 
     # -- rung-switch quiesce marker ----------------------------------------

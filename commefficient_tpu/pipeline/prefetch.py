@@ -53,9 +53,15 @@ class RoundWork(NamedTuple):
     device arrays, microbatch-reshaped for fedavg) and ``idx`` (index
     path: staged ``[W, B]`` int32 sample indices, with ``plan`` the staged
     augmentation plan) is set. ``env`` is the round's fedsim RoundEnv
-    (None when the simulator is off). ``host_ms`` is the wall-clock the
-    worker spent realizing + staging this round — the host serial time
-    the pipeline moved off the critical path."""
+    (None when the simulator is off). ``cohort`` is the staged
+    clientstore StagedCohort — the cohort's hosted [W, D] vel/err device
+    rows, gathered + H2D'd on this worker thread so the bank read
+    overlaps the previous round's compute; None unless the session hosts
+    client state (``--client_store host|mmap``). The dispatcher checks
+    its staleness version and regathers if the same client was updated
+    inside the pipeline window, so depth > 0 stays bit-exact. ``host_ms``
+    is the wall-clock the worker spent realizing + staging this round —
+    the host serial time the pipeline moved off the critical path."""
 
     step: int
     lr: float
@@ -65,6 +71,7 @@ class RoundWork(NamedTuple):
     plan: Any
     env: Any
     host_ms: float
+    cohort: Any = None
 
 
 _END = object()
@@ -150,11 +157,17 @@ class RoundPrefetcher:
             # while the device still computes earlier rounds
             if self.use_indices:
                 cids, idx, plan = sess.stage_round_indices(cids, idx, plan)
+                cohort = None
             else:
                 cids, batch = sess.stage_round_payload(cids, batch)
+                # hosted client rows (clientstore/): bank gather + H2D
+                # off the critical path too — None for device stores
+                cohort = sess.stage_cohort_rows(cids) if hasattr(
+                    sess, "stage_cohort_rows") else None
         return RoundWork(
             step=step, lr=lr, client_ids=cids, batch=batch, idx=idx,
             plan=plan, env=env, host_ms=(time.perf_counter() - t0) * 1e3,
+            cohort=cohort,
         )
 
     def _put(self, item) -> bool:

@@ -141,7 +141,19 @@ from commefficient_tpu.telemetry.xla_audit import (
 # exactly when a collective-hiding mode is on (overlap_collectives !=
 # 'none' or async_double_buffer) and forbidden otherwise, so wall-clock
 # rows are always attributable to their overlap setting.
-SCHEMA_VERSION = 9
+# v10 (clientstore PR): the clientstore/* scalar namespace (cache_hit_rate
+# in [0, 1]; evictions a non-negative integer-valued counter;
+# h2d_stage_ms and writeback_ms non-negative host gauges — all
+# checker-enforced), emitted at level >= 1 exactly when the session hosts
+# client state (--client_store host|mmap builds a CohortStreamer; the
+# device store constructs nothing, level-0 HLO bit-untouched).
+# perf_report.json's collectives block gains "sparse_agg_exemption"
+# (null | 'client_state_writeback'): the reason sparse_agg_bound exceeds
+# the strict W*k-class ceiling. DEVICE-resident client rows are the only
+# legal reason; on a sparse-aggregate report whose meta.config says
+# client_store host|mmap the checker REJECTS any exemption, so hosted
+# wall-clock rows are provably under the strict bound.
+SCHEMA_VERSION = 10
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

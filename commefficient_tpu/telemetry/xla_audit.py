@@ -295,6 +295,7 @@ class CompiledRoundAudit:
                  ledger_up_bytes: Optional[int] = None,
                  wk_bound: Optional[int] = None,
                  sparse_agg_bound: Optional[int] = None,
+                 sparse_agg_exemption: Optional[str] = None,
                  tolerance_bytes: Optional[int] = None,
                  async_info: Optional[dict] = None,
                  overlap_info: Optional[dict] = None,
@@ -324,6 +325,12 @@ class CompiledRoundAudit:
         coll = dict(collectives)
         coll["wk_bound"] = wk_bound
         coll["sparse_agg_bound"] = sparse_agg_bound
+        # why (if at all) sparse_agg_bound exceeds the strict W*k-class
+        # bound — 'client_state_writeback' when DEVICE-resident client
+        # rows inflate it. A hosted store (--client_store host|mmap) never
+        # sets it, and the schema checker REJECTS a host-store sparse
+        # report carrying any exemption (satellite of ROADMAP item 3)
+        coll["sparse_agg_exemption"] = sparse_agg_exemption
         coll["ledger_up_bytes"] = ledger_up_bytes
         if ledger_up_bytes is not None:
             delta = coll["total_bytes"] - int(ledger_up_bytes)

@@ -486,6 +486,11 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
             checkpointer.close()
         if resil is not None:
             resil.close()  # restore signal dispositions (crash paths too)
+        # drain + join the clientstore writeback worker and release the
+        # store (mmap flush/unlink) — a surviving process (embedding,
+        # pytest) must not leak the thread; no-op for device stores
+        if hasattr(session, "close_client_store"):
+            session.close_client_store()
     if not val:
         # resumed at/after the final round (the epoch loop never ran):
         # still evaluate so callers get final metrics instead of a KeyError

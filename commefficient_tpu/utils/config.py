@@ -30,6 +30,9 @@ CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
 # POLICIES); pinned equal by tests/test_mode_dispatch.py — same no-cycle
 # pattern as MODES/CONTROL_POLICIES
 RECOVER_POLICIES = ("none", "retry", "demote", "skip_clients")
+# mirrors the clientstore/ store registry (clientstore.available_stores);
+# pinned equal by tests/test_clientstore.py — same no-cycle pattern as MODES
+CLIENT_STORES = ("device", "host", "mmap")
 
 
 @dataclass(frozen=True)
@@ -172,15 +175,39 @@ class Config:
     device_data_max_mb: int = 512
 
     # --- memory (TPU-native; SURVEY.md §7 hard-parts) ---
-    # Keep [num_clients, D] client momentum/error rows in host RAM and move
-    # only the round's W participant rows across PCIe — required at GPT-2
-    # scale where num_clients * D does not fit HBM.
+    # Where the per-client momentum/error rows live (clientstore/ registry):
+    # "device" (default — today's [num_clients, D] device arrays inside
+    # FedState, bit-untouched; NOTHING clientstore-related is constructed,
+    # the telemetry_level-0 discipline), "host" (pinned-numpy bank in host
+    # RAM; only the round's W participant rows cross PCIe each round, C
+    # bounded by host DRAM), "mmap" (the same cohort-streaming contract
+    # over a memory-mapped file; C bounded by disk). host/mmap stream
+    # cohort rows through the pipeline prefetcher when one is active and
+    # write back asynchronously after the drain fence, so the compiled
+    # round's HLO carries no [C, D]-scale gather and the strict O(W·k)
+    # sparse-aggregate bound holds with no exemption (README
+    # "Host-resident client state").
+    client_store: str = "device"
+    # LRU device cache capacity (rows) for hot cohort rows under a
+    # host/mmap store — availability models make some clients far more
+    # frequent than others, and a cached row skips both the host gather
+    # and the H2D stage. 0 (default) = no cache (every round gathers from
+    # the bank). Write-through-on-eviction keeps the bank authoritative.
+    client_store_cache_rows: int = 0
+    # Backing file for --client_store mmap ("" = a run-scoped temp file,
+    # deleted on close). A named path persists across reopen — the store
+    # contract pins gather-after-reopen equality.
+    client_store_path: str = ""
+    # DEPRECATED: whole-store host offload, superseded by the per-cohort
+    # clientstore (--client_store host). Setting it warns and aliases to
+    # client_store="host"; the flag will be removed.
     offload_client_state: bool = False
     # FSDP-shard the flat param vector AND dense server momentum/error over
     # the workers mesh axis (parallel/fsdp.py): persistent per-chip state
     # drops from up to 3x[D] to ~[D/W] (+ small replicated sketch tables).
     # Server modes only (uncompressed/true_topk/sketch, threshold top-k);
-    # local modes shard their memory wall via offload_client_state instead.
+    # local modes shard their memory wall via --client_store host|mmap
+    # instead.
     fsdp: bool = False
     # Model compute precision: "mixed" (default — flax module matmuls
     # bf16, params/residual-boundaries f32), "bfloat16" (params also cast
@@ -692,6 +719,7 @@ class Config:
                 "sketch_table_dtype must be float32|bfloat16, "
                 f"got {self.sketch_table_dtype!r}"
             )
+        self._validate_client_store()
         self._validate_sketch_fused_bwd()
         self._validate_overlap_collectives()
         self._validate_scan_rounds()
@@ -775,6 +803,63 @@ class Config:
         self._validate_control()
         self._validate_resilience()
 
+    def _validate_client_store(self) -> None:
+        """Client-state placement flags (clientstore/). Runs FIRST among
+        the feature validators: the deprecated ``offload_client_state``
+        flag aliases into ``client_store='host'`` here, and every later
+        validator keys off the resolved ``client_state_hosted`` gate."""
+        if self.client_store not in CLIENT_STORES:
+            raise ValueError(
+                f"client_store must be one of {CLIENT_STORES}, got "
+                f"{self.client_store!r}"
+            )
+        if self.offload_client_state:
+            import warnings
+
+            warnings.warn(
+                "offload_client_state is deprecated: the whole-store "
+                "offload became the per-cohort client-state store — use "
+                "--client_store host (identical semantics at whole-store "
+                "granularity; adds mmap backing and the LRU device cache)",
+                DeprecationWarning,
+                stacklevel=3,
+            )
+            if self.client_store == "device":
+                object.__setattr__(self, "client_store", "host")
+        if self.client_store_cache_rows < 0:
+            raise ValueError(
+                f"client_store_cache_rows must be >= 0 (0 = no cache), "
+                f"got {self.client_store_cache_rows}"
+            )
+        if self.client_store == "device":
+            if self.client_store_cache_rows:
+                raise ValueError(
+                    "client_store_cache_rows caches host-store cohort rows "
+                    "on device; with client_store='device' the whole bank "
+                    "already lives in HBM — drop the cache flag or pick "
+                    "--client_store host|mmap"
+                )
+            if self.client_store_path:
+                raise ValueError(
+                    "client_store_path backs the mmap store; with "
+                    f"client_store={self.client_store!r} it would be "
+                    "silently ignored — use --client_store mmap"
+                )
+        if self.client_store == "host" and self.client_store_path:
+            raise ValueError(
+                "client_store_path backs the mmap store; the host store "
+                "is a RAM bank — use --client_store mmap to persist to "
+                f"{self.client_store_path!r}"
+            )
+        if self.client_state_hosted and self.fsdp:
+            raise ValueError(
+                "client_store='host'/'mmap' streams per-cohort rows "
+                "through the replicated round builder; the FSDP round "
+                "shards server state instead (local modes host their "
+                "memory wall via --client_store, server modes via "
+                "--fsdp) — run one or the other"
+            )
+
     def _validate_sketch_fused_bwd(self) -> None:
         """The sketch-fused backward produces the gradient directly as an
         encoded table, so it only exists on the fused flattened-batch
@@ -855,11 +940,11 @@ class Config:
                 "in HBM; set device_data=True (host-batch rounds would "
                 "serialize on H2D anyway)"
             )
-        if self.offload_client_state or self.fsdp:
+        if self.client_state_hosted or self.fsdp:
             raise ValueError(
                 "scan_rounds > 1 needs the device-resident index path, "
-                "which excludes offload_client_state/fsdp (host-resident "
-                "rows cross PCIe between rounds)"
+                "which excludes --client_store host|mmap and fsdp "
+                "(host-resident rows cross PCIe between rounds)"
             )
         if self.control_enabled:
             raise ValueError(
@@ -943,11 +1028,11 @@ class Config:
                 "the fused flattened-batch paths produce one device-level "
                 "gradient — drop fuse_clients/sketch_fused_bwd"
             )
-        if self.offload_client_state or self.fsdp:
+        if self.client_state_hosted or self.fsdp:
             raise ValueError(
                 "async_buffer > 0 currently requires HBM-resident client "
-                "state on the replicated engine "
-                "(offload_client_state/fsdp run their own round builders)"
+                "state on the replicated engine (--client_store host|mmap "
+                "and fsdp run their own round builders)"
             )
         if self.scan_rounds > 1:
             raise ValueError(
@@ -1165,6 +1250,17 @@ class Config:
         preemption guard has its own gate: ``preempt_signals`` or a
         ``preempt@R`` chaos event.)"""
         return self.recover_policy != "none"
+
+    @property
+    def client_state_hosted(self) -> bool:
+        """True when per-client momentum/error rows live OUTSIDE the
+        traced graph (clientstore/ host or mmap bank): the round functions
+        take the cohort's [W, D] rows as arguments and FedState carries no
+        [num_clients, D] leaves. False keeps today's device-resident
+        arrays and constructs nothing clientstore-related — the
+        fedsim_enabled/control_enabled gate discipline (golden parity and
+        level-0 HLO bit-untouched)."""
+        return self.client_store in ("host", "mmap")
 
     @property
     def pipeline_enabled(self) -> bool:

@@ -75,14 +75,23 @@ from pathlib import Path
 # "overlap" block {collectives: 'none'|'layerwise', double_buffer} —
 # REQUIRED when the report's config has a hiding mode on
 # (overlap_collectives != 'none' or async_double_buffer), FORBIDDEN when
-# both are off, and never all-off when present (enforced below). Older
-# artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
+# both are off, and never all-off when present (enforced below); v10
+# (clientstore PR): clientstore/* scalar namespace (cache_hit_rate in
+# [0, 1], integer-valued evictions >= 0, h2d_stage_ms / writeback_ms
+# >= 0 — enforced below) and perf_report collectives
+# "sparse_agg_exemption" (null | 'client_state_writeback') — on a
+# sparse-aggregate report whose config hosts client state
+# (client_store host|mmap) ANY exemption is rejected: the hosted round
+# takes cohort rows as arguments, so the strict W*k-class
+# sparse_agg_bound must hold with no [C, D] writeback allowance
+# (enforced below). Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
-                   "control/", "pipeline/", "resilience/", "async/")
+                   "control/", "pipeline/", "resilience/", "async/",
+                   "clientstore/")
 
 
 class SchemaError(ValueError):
@@ -294,6 +303,38 @@ def _check_async_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_clientstore_scalar(name: str, v, where: str) -> None:
+    """v10 ``clientstore/*`` value invariants. Host-computed gauges from
+    the CohortStreamer (clientstore/streamer.py), never legitimately
+    non-finite: ``cache_hit_rate`` is hits/(hits+misses) over one round
+    (a real fraction, 0.0 with no cache); ``evictions`` counts whole
+    rows leaving the LRU cache; the ``*_ms`` pair are perf_counter
+    timings of the H2D stage and the bank writeback."""
+    if not name.startswith("clientstore/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "clientstore/cache_hit_rate" and not 0.0 <= v <= 1.0:
+        raise SchemaError(
+            f"{where}: clientstore/cache_hit_rate {v} outside [0, 1] — "
+            "it is hits/(hits+misses) over one round"
+        )
+    if name == "clientstore/evictions" and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: clientstore/evictions {v} is not a non-negative "
+            "integer — it counts whole rows written through the cache"
+        )
+    if name in ("clientstore/h2d_stage_ms",
+                "clientstore/writeback_ms") and v < 0:
+        raise SchemaError(
+            f"{where}: {name} {v} is negative — host wall-clock gauges "
+            "are >= 0"
+        )
+
+
 def _check_xla_scalar(name: str, v, where: str) -> None:
     """v9 ``xla/exposed_collective_ms`` value invariant: a host-computed
     cumulative gauge (interval arithmetic over the span recorder — never
@@ -381,6 +422,7 @@ def validate_metrics_jsonl(path) -> int:
             _check_pipeline_scalar(name, rec["value"], where)
             _check_resilience_scalar(name, rec["value"], where)
             _check_async_scalar(name, rec["value"], where)
+            _check_clientstore_scalar(name, rec["value"], where)
             _check_xla_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
@@ -567,6 +609,7 @@ def validate_flight(path) -> dict:
             _check_pipeline_scalar(name, v, w)
             _check_resilience_scalar(name, v, w)
             _check_async_scalar(name, v, w)
+            _check_clientstore_scalar(name, v, w)
             _check_xla_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
@@ -757,6 +800,28 @@ def validate_perf_report(path) -> dict:
             raise SchemaError(
                 f"{where}: sparse aggregation requires a positive "
                 "sparse_agg_bound"
+            )
+        # v10: a hosted client store (--client_store host|mmap) passes the
+        # cohort's rows as round ARGUMENTS, so the [C, D]-scale writeback
+        # gather never exists in the HLO and the STRICT W*k-class bound
+        # must hold — an exemption marker on such a report means the
+        # producer inflated sparse_agg_bound it had no right to, so the
+        # elems-vs-bound checks below would be vacuous. Reject it.
+        exemption = coll.get("sparse_agg_exemption")
+        if exemption is not None and exemption != "client_state_writeback":
+            raise SchemaError(
+                f"{where}: unknown sparse_agg_exemption {exemption!r} "
+                "(known: 'client_state_writeback')"
+            )
+        hosted = cfg_blk.get("client_store", "device") in ("host", "mmap")
+        if hosted and exemption is not None:
+            raise SchemaError(
+                f"{where}: sparse-aggregate report carries "
+                f"sparse_agg_exemption={exemption!r} but its config hosts "
+                "client state (client_store="
+                f"{cfg_blk.get('client_store')!r}) — hosted rounds take "
+                "cohort rows as arguments, so the strict W*k bound holds "
+                "with NO writeback allowance (schema v10)"
             )
         for field, opname in (("max_all_gather_elems", "all-gather"),
                               ("max_all_reduce_elems", "all-reduce")):

@@ -776,3 +776,88 @@ def test_v9_report_without_hiding_modes_has_no_overlap_block(tmp_path):
     with open(old, "w") as f:
         json.dump(r, f)
     mod.validate_perf_report(old)
+
+
+def test_v10_clientstore_scalars_validate_and_reject(tmp_path):
+    """The clientstore/ scalar prefix is in-schema through the REAL
+    writer; value invariants (hit-rate fraction, integer eviction gauge,
+    non-negative wall-clock) are enforced. The end-to-end form — these
+    scalars riding a hosted run's drained metrics — is pinned by
+    tests/test_clientstore.py."""
+    mod = _checker()
+    cfg = Config(mode="local_topk", error_type="local", local_momentum=0.9,
+                 k=30, telemetry_level=1, num_workers=8, num_devices=8,
+                 client_store="host", client_store_cache_rows=4)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("clientstore/cache_hit_rate", 0.5, s)
+        writer.scalar("clientstore/evictions", float(s), s)
+        writer.scalar("clientstore/h2d_stage_ms", 0.3, s)
+        writer.scalar("clientstore/writeback_ms", 0.0, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 18
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "clientstore/cache_hit_rate", "value": 1.5, "step": 0,
+          "t": 1.0}, r"outside \[0, 1\]"),
+        ({"name": "clientstore/cache_hit_rate", "value": -0.1, "step": 0,
+          "t": 1.0}, r"outside \[0, 1\]"),
+        ({"name": "clientstore/evictions", "value": 0.5, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "clientstore/evictions", "value": -1.0, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "clientstore/h2d_stage_ms", "value": -0.1, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "clientstore/writeback_ms", "value": -2.0, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "clientstore/cache_hit_rate", "value": True, "step": 0,
+          "t": 1.0}, "neither a number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def test_v10_perf_report_rejects_hosted_exemption(tmp_path):
+    """A sparse-aggregate report whose config hosts client state may not
+    carry ANY sparse_agg_exemption (the [C, D] writeback gather does not
+    exist in the hosted HLO); unknown exemption markers are rejected
+    outright. The accepting side — a REAL hosted audit passing the strict
+    bound — is pinned by tests/test_clientstore.py."""
+    mod = _checker()
+    path = _write_perf_report(tmp_path)
+    rec = mod.validate_perf_report(path)
+    assert rec["collectives"]["sparse_agg_exemption"] is None
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_perf.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_perf_report(bad)
+
+    # recast as a sparse-aggregate report (generous bound: only the
+    # exemption rules should fire)
+    def sparse(r):
+        r["aggregate"] = "sparse"
+        r["collectives"]["sparse_agg_bound"] = 10 ** 9
+
+    def unknown_marker(r):
+        sparse(r)
+        r["collectives"]["sparse_agg_exemption"] = "hand_wave"
+
+    def host_with_exemption(r):
+        sparse(r)
+        r["meta"]["config"]["client_store"] = "host"
+        r["collectives"]["sparse_agg_exemption"] = "client_state_writeback"
+
+    tampered(unknown_marker, "unknown sparse_agg_exemption")
+    tampered(host_with_exemption, "hosts client state")
