@@ -201,8 +201,10 @@ class AsyncFederation:
                 self._launch_work(c, work)
 
     # -- launch ------------------------------------------------------------
-    def _span(self, name: str, collective: bool = False):
-        return self.spans.span(name, collective=collective) if (
+    def _span(self, name: str, collective: bool = False, trace_id=None,
+              parent=None):
+        return self.spans.span(name, collective=collective,
+                               trace_id=trace_id, parent=parent) if (
             self.spans is not None) else nullcontext()
 
     def _drain_deferred(self) -> None:
@@ -211,11 +213,19 @@ class AsyncFederation:
         then measures only the collective time the launches failed to
         hide — and on every path that leaves the steady-state loop
         (restart/close/snapshot), so the window never rides an unfenced
-        apply into the vault."""
+        apply into the vault. The drain span carries the PARKED update's
+        trace id + step (schema v11): it fences that round's apply, not
+        the round whose loop iteration happens to run it."""
         if self._deferred is None:
             return
-        loss, self._deferred = self._deferred, None
-        with self._span("async_apply_drain", collective=True) as sp:
+        (loss, step), self._deferred = self._deferred, None
+        from commefficient_tpu.telemetry.trace import round_trace_id
+
+        if self.spans is None:
+            return
+        with self.spans.span("async_apply_drain", collective=True,
+                             step=step,
+                             trace_id=round_trace_id(step)) as sp:
             if sp is not None:
                 sp.fence(loss)
 
@@ -242,7 +252,16 @@ class AsyncFederation:
                              sess._batch_sharding)
         version = int(self.schedule.launch_version[c])
         st = sess.state
-        with self._span("async_launch"):
+        from commefficient_tpu.telemetry.trace import (
+            cohort_trace_id,
+            round_trace_id,
+        )
+
+        # the cohort's trace id roots its whole lifecycle (launch ->
+        # buffer residency -> consuming applies); its parent is the
+        # server round whose params it launched against (schema v11)
+        with self._span("async_launch", trace_id=cohort_trace_id(c),
+                        parent=round_trace_id(version)):
             out = launch_fn(
                 st.params_vec, st.client_vel, st.client_err, ids, work.batch,
                 jnp.int32(version), jnp.float32(work.lr), env=fs,
@@ -254,6 +273,11 @@ class AsyncFederation:
             "stats": stats,
             "version": version,
             "rung": int(sess.active_rung),
+            # launch-time clock for the retroactive buffer-residency
+            # span recorded when the cohort fully retires (absent on
+            # vault-restored windows — the original launch time did not
+            # survive the snapshot, so no residency span is recorded)
+            "t_launch": time.perf_counter(),
         }
         self._cohorts_launched += 1
         self._cohort_horizon = max(self._cohort_horizon, c + 1)
@@ -368,9 +392,15 @@ class AsyncFederation:
         # rows are dense transmits, re-encoded under the new rung)
         sess._control_round_start(fs_stats)
         _, apply_fn = sess.async_round_fns(sess.active_rung)
+        from commefficient_tpu.telemetry.trace import (
+            cohort_trace_id,
+            round_trace_id,
+        )
+
         name = ("async_apply_dispatch" if self._double_buffer
                 else "async_apply")
-        with self._span(name, collective=not self._double_buffer) as sp:
+        with self._span(name, collective=not self._double_buffer,
+                        trace_id=round_trace_id(step)) as sp:
             sess.state, metrics = apply_fn(
                 sess.state, put(rows), put(vel_rows), put(err_rows),
                 put(loss_rows), jax.tree.map(put, aux_rows),
@@ -379,9 +409,10 @@ class AsyncFederation:
             )
             if sp is not None:
                 if self._double_buffer:
-                    # park the fence target; _drain_deferred fences it
-                    # after the NEXT update's launches dispatch
-                    self._deferred = metrics["loss"]
+                    # park the fence target (with its step, so the drain
+                    # span names the round it fences); _drain_deferred
+                    # fences it after the NEXT update's launches dispatch
+                    self._deferred = (metrics["loss"], step)
                 else:
                     sp.fence(metrics["loss"])
         # mirror train_round's clock discipline: the availability/chaos
@@ -392,7 +423,17 @@ class AsyncFederation:
             self._consumed[c] = self._consumed.get(c, 0) + 1
         for c in {cc for cc, _ in spec.slots}:
             if self._consumed.get(c, 0) >= W:
-                self._pending.pop(c, None)  # fully consumed -> retire
+                p = self._pending.pop(c, None)  # fully consumed -> retire
+                if (p is not None and self.spans is not None
+                        and "t_launch" in p):
+                    # retroactive buffer-residency span: launch ->
+                    # retirement, on the cohort's own trace (schema v11)
+                    self.spans.span_at(
+                        "async_buffer_residency", p["t_launch"],
+                        time.perf_counter(), step=step,
+                        trace_id=cohort_trace_id(c),
+                        parent=round_trace_id(p["version"]),
+                    )
         stats = sess._host_round_stats(fs_stats)
         return {**metrics, **stats} if stats else metrics
 

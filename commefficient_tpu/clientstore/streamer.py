@@ -31,6 +31,17 @@ A writeback fault is stored and re-raised at the next gather/flush
 (the prefetcher's consumer-side fault discipline). Per-round
 ``clientstore/*`` scalars (cache hit rate, evictions, H2D stage ms,
 writeback ms) accumulate here and drain via ``pop_round_stats``.
+
+Trace correlation (schema v11): when the session attaches a PhaseSpans
+recorder (its ``spans`` setter forwards here), gather/writeback/flush
+record spans — ``clientstore_gather`` on the calling thread (usually
+the prefetch lane), ``clientstore_writeback`` on the worker's own
+labeled lane, ``clientstore_flush`` on the fencing thread — and
+gather/scatter accept the owning round's ``trace_id`` from the caller
+(the streamer has no round clock of its own), so a Perfetto dump links
+a cohort's H2D stage and its async writeback to the round that owned
+them. ``spans=None`` (the default, and every level-0 run) keeps all of
+it on the zero-cost fast path.
 """
 
 from __future__ import annotations
@@ -58,14 +69,18 @@ class StagedCohort(NamedTuple):
 
 
 class _WriteEntry:
-    __slots__ = ("ids", "idset", "vel", "err", "done")
+    __slots__ = ("ids", "idset", "vel", "err", "done", "trace_id")
 
-    def __init__(self, ids, vel, err):
+    def __init__(self, ids, vel, err, trace_id=None):
         self.ids = ids
         self.idset = set(int(i) for i in ids)
         self.vel = vel
         self.err = err
         self.done = threading.Event()
+        # owning round's trace id (schema v11): the worker stamps its
+        # clientstore_writeback span with it, so the async write renders
+        # in the round's causal tree even though it runs rounds later
+        self.trace_id = trace_id
 
 
 class CohortStreamer:
@@ -93,6 +108,10 @@ class CohortStreamer:
         self._stage_ms = 0.0
         self._writeback_ms = 0.0
         self._hits0 = self._misses0 = self._evictions0 = 0
+        # PhaseSpans recorder — the session's ``spans`` setter forwards
+        # its attachment here; None keeps every span site zero-cost
+        self.spans = None
+        self._worker_lane_named = False
 
     # ------------------------------------------------------------------
     # writeback machinery
@@ -129,8 +148,10 @@ class CohortStreamer:
                     self.vel_store.scatter_rows(e.ids, np.asarray(e.vel))
                 if e.err is not None:
                     self.err_store.scatter_rows(e.ids, np.asarray(e.err))
+                t1 = time.perf_counter()
                 with self._lock:
-                    self._writeback_ms += (time.perf_counter() - t0) * 1e3
+                    self._writeback_ms += (t1 - t0) * 1e3
+                self._record_writeback_span(e, t0, t1)
             except BaseException as exc:  # noqa: BLE001 — re-raised at the consumer
                 with self._lock:
                     self._fault = exc
@@ -139,6 +160,22 @@ class CohortStreamer:
                     if e in self._pending:
                         self._pending.remove(e)
                 e.done.set()
+
+    def _record_writeback_span(self, e, t0: float, t1: float) -> None:
+        """Stamp one ``clientstore_writeback`` span on the worker's own
+        labeled lane (schema v11) — retroactive ``span_at`` because the
+        interval is already over when we know it completed cleanly."""
+        spans = self.spans
+        if spans is None:
+            return
+        if not self._worker_lane_named:
+            spans.register_lane("clientstore-writeback")
+            self._worker_lane_named = True
+        from commefficient_tpu.telemetry.trace import step_of_trace_id
+
+        spans.span_at("clientstore_writeback", t0, t1,
+                      step=step_of_trace_id(e.trace_id),
+                      trace_id=e.trace_id)
 
     def _raise_fault(self) -> None:
         with self._lock:
@@ -158,8 +195,10 @@ class CohortStreamer:
     def has_err(self) -> bool:
         return self.err_store is not None
 
-    def gather(self, cids) -> StagedCohort:
-        """Realize the cohort's device rows (cache-first, then bank)."""
+    def gather(self, cids, trace_id=None) -> StagedCohort:
+        """Realize the cohort's device rows (cache-first, then bank).
+        ``trace_id=`` stamps the ``clientstore_gather`` span with the
+        owning round (schema v11) — the caller knows it, we don't."""
         self._raise_fault()
         ids = np.asarray(cids).reshape(-1)
         idset = set(int(i) for i in ids)
@@ -180,8 +219,16 @@ class CohortStreamer:
         t0 = time.perf_counter()
         vel = self._assemble(self.vel_store, ids, missing, cached, bank=0)
         err = self._assemble(self.err_store, ids, missing, cached, bank=1)
+        t1 = time.perf_counter()
         with self._lock:
-            self._stage_ms += (time.perf_counter() - t0) * 1e3
+            self._stage_ms += (t1 - t0) * 1e3
+        spans = self.spans
+        if spans is not None:
+            from commefficient_tpu.telemetry.trace import step_of_trace_id
+
+            spans.span_at("clientstore_gather", t0, t1,
+                          step=step_of_trace_id(trace_id),
+                          trace_id=trace_id)
         return StagedCohort(vel, err, version)
 
     def _assemble(self, store, ids, missing, cached, bank):
@@ -212,9 +259,11 @@ class CohortStreamer:
         with self._lock:
             return bool((self._last_write[ids] > version).any())
 
-    def scatter(self, cids, new_vel, new_err) -> None:
+    def scatter(self, cids, new_vel, new_err, trace_id=None) -> None:
         """Write the round's updated rows back (per-bank ``()``/None for
-        absent banks). Returns immediately; ``flush()`` is the fence."""
+        absent banks). Returns immediately; ``flush()`` is the fence.
+        ``trace_id=`` rides the write entry so the async worker's
+        ``clientstore_writeback`` span names its owning round."""
         self._raise_fault()
         ids = np.asarray(cids).reshape(-1)
         # an absent bank's return slot is () or a [W, 1] zeros placeholder
@@ -235,7 +284,7 @@ class CohortStreamer:
                          err[pos] if err is not None else None),
                         dirty=True)
                 return
-            entry = _WriteEntry(ids, vel, err)
+            entry = _WriteEntry(ids, vel, err, trace_id=trace_id)
             self._pending.append(entry)
             self._ensure_worker()
         self._q.put(entry)
@@ -244,7 +293,10 @@ class CohortStreamer:
         """The drain fence: join pending writebacks and write dirty
         cache rows through — after it the banks hold every completed
         round's rows (checkpoint save / vault snapshot / whole-bank
-        reads all fence here)."""
+        reads all fence here). Recorded as a ``clientstore_flush`` span
+        on the fencing thread (no trace id — a flush fences ALL pending
+        rounds, it belongs to none of them)."""
+        t0 = time.perf_counter()
         with self._lock:
             waits = list(self._pending)
         for e in waits:
@@ -256,6 +308,8 @@ class CohortStreamer:
         for store in (self.vel_store, self.err_store):
             if store is not None:
                 store.flush()
+        if self.spans is not None:
+            self.spans.span_at("clientstore_flush", t0, time.perf_counter())
 
     # ------------------------------------------------------------------
     # whole-bank access (checkpoint / vault) — callers fence via the

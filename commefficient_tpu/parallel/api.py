@@ -955,17 +955,35 @@ class FederatedSession:
         return (live, corr, cnt), dict(env.stats)
 
     # -- host-side round observability (telemetry) -------------------------
-    def _span(self, name: str, fence=None, collective: bool = False):
+    @property
+    def spans(self):
+        """The attached PhaseSpans recorder (None below level 1). A
+        property so attaching/detaching also reaches the clientstore
+        streamer's writeback lane — the streamer is constructed at
+        session build time, long before build_perf_observability runs."""
+        return self._spans
+
+    @spans.setter
+    def spans(self, value) -> None:
+        self._spans = value
+        streamer = getattr(self, "_streamer", None)
+        if streamer is not None:
+            streamer.spans = value
+
+    def _span(self, name: str, fence=None, collective: bool = False,
+              trace_id=None):
         """Phase-span context (telemetry/spans.py) — a nullcontext yielding
         None unless a train loop attached a recorder (level >= 1).
         ``collective=True`` tags the span for the exposed-collective
         accounting (the round-dispatch spans: their fence waits on the
-        program's aggregation collectives)."""
+        program's aggregation collectives); ``trace_id=`` stamps the
+        owning round's id (schema v11)."""
         if self.spans is None:
             from contextlib import nullcontext
 
             return nullcontext()
-        return self.spans.span(name, fence=fence, collective=collective)
+        return self.spans.span(name, fence=fence, collective=collective,
+                               trace_id=trace_id)
 
     def _host_round_stats(self, fs_stats: dict) -> dict:
         """Host scalars riding this round's metric dict: the fedsim stats,
@@ -993,6 +1011,19 @@ class FederatedSession:
             # evictions, H2D stage ms, async writeback ms — drained per
             # round so the key set stays constant
             stats.update(self._streamer.pop_round_stats())
+        if self.spans is not None and self.cfg.telemetry_level >= 1:
+            # trace/* critical-path scalars (schema v11), LAGGED: at
+            # this point round _round_clock-1 just dispatched (its drain
+            # has not run), so the newest round whose spans are complete
+            # is _round_clock-2 — early rounds emit the zeros row
+            # (constant key set, pack_metric_dicts discipline)
+            from commefficient_tpu.telemetry.trace import (
+                trace_round_scalars,
+            )
+
+            stats.update(
+                trace_round_scalars(self.spans, self._round_clock - 2)
+            )
         return stats
 
     def _control_round_start(self, fs_stats: dict) -> None:
@@ -1004,13 +1035,17 @@ class FederatedSession:
 
     def train_round_indices(self, client_ids, idx, plan, lr: float, env=None):
         """Run one round from device-resident data (see ``attach_data``)."""
-        with self._span("device_put"):
+        from commefficient_tpu.telemetry.trace import round_trace_id
+
+        tid = round_trace_id(self._round_clock)
+        with self._span("device_put", trace_id=tid):
             cids, idxd, pl = self.stage_round_indices(client_ids, idx, plan)
             ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
-        with self._span("fedsim_env"):
+        with self._span("fedsim_env", trace_id=tid):
             fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
-        with self._span("round_dispatch", collective=True) as sp:
+        with self._span("round_dispatch", collective=True,
+                        trace_id=tid) as sp:
             self.state, metrics = self._round_idx_fn(
                 self.state, self._dev_data, ids, idxd, pl, jnp.float32(lr),
                 env=fs_env,
@@ -1023,27 +1058,34 @@ class FederatedSession:
         return {**metrics, **stats} if stats else metrics
 
     # -- train ------------------------------------------------------------
-    def stage_cohort_rows(self, client_ids):
+    def stage_cohort_rows(self, client_ids, trace_id=None):
         """Realize the cohort's hosted [W, D] device rows (or None when
         the session has no hosted store) — the prefetcher calls this from
         its worker thread so the clientstore gather + H2D overlap the
         previous round's compute; ``train_round(..., cohort=)`` consumes
-        the result, regathering only if the staged rows went stale."""
+        the result, regathering only if the staged rows went stale.
+        ``trace_id=`` stamps the gather span with the round being
+        prefetched (the prefetcher knows it; this session does not)."""
         if self._streamer is None:
             return None
-        return self._streamer.gather(np.asarray(client_ids))
+        return self._streamer.gather(np.asarray(client_ids),
+                                     trace_id=trace_id)
 
     def train_round(self, client_ids: np.ndarray, batch: Dict[str, np.ndarray],
                     lr: float, env=None, cohort=None):
-        with self._span("device_put"):
+        from commefficient_tpu.telemetry.trace import round_trace_id
+
+        tid = round_trace_id(self._round_clock)
+        with self._span("device_put", trace_id=tid):
             cids, dev_batch = self.stage_round_payload(client_ids, batch)
             ids = jax.device_put(jnp.asarray(cids), self._batch_sharding)
         lr = jnp.float32(lr)
-        with self._span("fedsim_env"):
+        with self._span("fedsim_env", trace_id=tid):
             fs_env, fs_stats = self._fedsim_round_env(env, client_ids=cids)
         self._control_round_start(fs_stats)
         if self._streamer is None:
-            with self._span("round_dispatch", collective=True) as sp:
+            with self._span("round_dispatch", collective=True,
+                            trace_id=tid) as sp:
                 self.state, metrics = self.round_fn(
                     self.state, ids, dev_batch, lr, env=fs_env
                 )
@@ -1061,8 +1103,9 @@ class FederatedSession:
         # pipeline window) — the staleness regather keeps pipelined runs
         # bit-exact with the sequential schedule.
         if cohort is None or self._streamer.is_stale(cids, cohort.version):
-            cohort = self._streamer.gather(cids)
-        with self._span("round_dispatch", collective=True) as sp:
+            cohort = self._streamer.gather(cids, trace_id=tid)
+        with self._span("round_dispatch", collective=True,
+                        trace_id=tid) as sp:
             self.state, metrics, new_vel, new_err = self.round_fn(
                 self.state, ids, dev_batch, lr, cohort.vel, cohort.err,
                 env=fs_env,
@@ -1074,7 +1117,7 @@ class FederatedSession:
         # async writeback: the worker thread syncs new_vel/new_err D2H and
         # scatters into the bank off the host loop's critical path; the
         # flush fence (checkpoint/vault via host_vel, or close) joins it
-        self._streamer.scatter(cids, new_vel, new_err)
+        self._streamer.scatter(cids, new_vel, new_err, trace_id=tid)
         stats = self._host_round_stats(fs_stats)
         return {**metrics, **stats} if stats else metrics
 

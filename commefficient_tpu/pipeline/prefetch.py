@@ -130,7 +130,13 @@ class RoundPrefetcher:
             from contextlib import nullcontext
 
             return nullcontext()
-        return self.spans.span(name, step=step)
+        from commefficient_tpu.telemetry.trace import round_trace_id
+
+        # every prefetch span names the round it is REALIZING (schema
+        # v11) — the Perfetto tree links this lane's work to the
+        # dispatch-lane spans of the same round
+        return self.spans.span(name, step=step,
+                               trace_id=round_trace_id(step))
 
     def _realize(self, step: int) -> RoundWork:
         t0 = time.perf_counter()
@@ -161,9 +167,17 @@ class RoundPrefetcher:
             else:
                 cids, batch = sess.stage_round_payload(cids, batch)
                 # hosted client rows (clientstore/): bank gather + H2D
-                # off the critical path too — None for device stores
-                cohort = sess.stage_cohort_rows(cids) if hasattr(
-                    sess, "stage_cohort_rows") else None
+                # off the critical path too — None for device stores;
+                # the gather span inherits this round's trace id
+                if hasattr(sess, "stage_cohort_rows"):
+                    from commefficient_tpu.telemetry.trace import (
+                        round_trace_id,
+                    )
+
+                    cohort = sess.stage_cohort_rows(
+                        cids, trace_id=round_trace_id(step))
+                else:
+                    cohort = None
         return RoundWork(
             step=step, lr=lr, client_ids=cids, batch=batch, idx=idx,
             plan=plan, env=env, host_ms=(time.perf_counter() - t0) * 1e3,

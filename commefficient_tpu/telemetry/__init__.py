@@ -72,6 +72,17 @@ from commefficient_tpu.telemetry.flight import (
 )
 from commefficient_tpu.telemetry.ledger import CommLedger, run_metadata
 from commefficient_tpu.telemetry.spans import PhaseSpans
+from commefficient_tpu.telemetry.trace import (
+    STAGES,
+    CriticalPath,
+    ProfilerStack,
+    ProfilerWindow,
+    build_run_report,
+    cohort_trace_id,
+    round_trace_id,
+    trace_round_scalars,
+    write_run_report,
+)
 from commefficient_tpu.telemetry.xla_audit import (
     CompiledRoundAudit,
     RetraceError,
@@ -153,7 +164,23 @@ from commefficient_tpu.telemetry.xla_audit import (
 # legal reason; on a sparse-aggregate report whose meta.config says
 # client_store host|mmap the checker REJECTS any exemption, so hosted
 # wall-clock rows are provably under the strict bound.
-SCHEMA_VERSION = 10
+# v11 (round-tracing PR): the trace/* scalar namespace — per-round
+# critical-path attribution with LAGGED semantics (telemetry/trace.py:
+# the row emitted at round N describes round N-2, the newest round
+# whose spans are complete at emission time):
+# trace/critical_stage an integer index into trace.STAGES,
+# trace/<stage>_exclusive_ms non-negative finite host gauges, one per
+# stage, disjoint by construction and summing to <= the analyzed
+# round's wall-clock. Spans events may carry args.trace_id (non-empty
+# string: the owning round "r<step>" or cohort "c<cohort>") and
+# args.parent (non-empty, != trace_id, only beside a trace_id) so a
+# dump renders each cohort as a causally-linked tree across lanes. New
+# run_report.json artifact (kind "run_report": per-stage p50/p95,
+# attribution fractions in [0,1] summing to ~1, per-round stage times
+# disjoint and <= wall_ms, anomaly flags), written at train-loop close
+# when cfg.run_report and by scripts/analyze_run.py; the header/flight
+# artifacts block advertises it under the same gate.
+SCHEMA_VERSION = 11
 
 TELEMETRY_LEVELS = (0, 1, 2)
 
@@ -175,6 +202,15 @@ def run_artifacts(cfg, logdir: str) -> dict:
         import os
 
         out["perf_report"] = os.path.join(logdir, "perf_report.json")
+    if (logdir and getattr(cfg, "telemetry_level", 0) >= 1
+            and getattr(cfg, "run_report", True)):
+        # v11: the critical-path run report, written at train-loop close
+        # (telemetry/trace.py). Same opt-out discipline as perf_report:
+        # accuracy_run passes run_report=False so its headers/flight
+        # dumps never link an artifact that will not exist.
+        import os
+
+        out["run_report"] = os.path.join(logdir, "run_report.json")
     return out
 
 
@@ -268,27 +304,36 @@ def record_crash(flight, exc) -> None:
 
 __all__ = [
     "SCHEMA_VERSION",
+    "STAGES",
     "TELEMETRY_LEVELS",
     "CommLedger",
     "CompiledRoundAudit",
+    "CriticalPath",
     "DivergenceError",
     "FlightRecorder",
     "PhaseSpans",
+    "ProfilerStack",
+    "ProfilerWindow",
     "RetraceError",
     "RetraceSentinel",
     "audited_mfu",
     "build_perf_observability",
+    "build_run_report",
     "build_telemetry_riders",
     "chip_peak_flops",
+    "cohort_trace_id",
     "collective_audit",
     "exposed_collective_ms",
     "jsonable_scalar",
     "jsonable_tree",
     "nonfinite_sentinel",
     "record_crash",
+    "round_trace_id",
     "run_artifacts",
     "round_diagnostics",
     "round_diagnostics_sparse",
     "run_metadata",
     "table_sqnorm_estimate",
+    "trace_round_scalars",
+    "write_run_report",
 ]

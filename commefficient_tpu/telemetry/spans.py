@@ -46,14 +46,28 @@ as a top-level ``"exposed_collective_ms"`` field so the audit's
 spans×HLO cross-check (telemetry/xla_audit.py ``exposed_collective_ms``)
 can gate it on the compiled programs actually containing collectives.
 
+Trace correlation (schema v11): spans may carry ``trace_id`` — the
+owning round's or cohort's id (telemetry/trace.py mints them:
+``r<step>`` for rounds, ``c<cohort>`` for async cohorts) — and
+``parent`` (the trace id this one causally descends from, e.g. a
+cohort's launching round). With all four planes (prefetch, clientstore
+writeback, asyncfed, dispatch) stamping their spans, a Perfetto dump
+renders each cohort as a causally-linked tree across lanes, and the
+``CriticalPath`` analyzer can attribute a round's wall-clock to the
+stage that bound it. ``span_at`` records a span RETROACTIVELY from
+explicit perf_counter endpoints — the async engine only knows a
+cohort's buffer-residency interval when the cohort retires.
+
 Format: ``{"schema_version", "kind": "spans", "displayTimeUnit",
 "exposed_collective_ms", "traceEvents": [{"name", "ph": "X", "ts",
-"dur", "pid", "tid", "args": {"step", "fenced"[, "collective"]}} |
+"dur", "pid", "tid", "args": {"step", "fenced"[, "collective"]
+[, "trace_id"][, "parent"]}} |
 {"name": "thread_name", "ph": "M", "pid", "tid", "args": {"name"}}]}``
 — ts/dur in microseconds since the recorder was constructed (Chrome
 trace convention). Validated by scripts/check_telemetry_schema.py
 (schema v3; "M" thread-name metadata events since v5;
-``exposed_collective_ms`` since v9).
+``exposed_collective_ms`` since v9; ``trace_id``/``parent`` args since
+v11).
 """
 
 from __future__ import annotations
@@ -160,7 +174,8 @@ class PhaseSpans:
     # -- recording ---------------------------------------------------------
     @contextmanager
     def span(self, name: str, fence=None, step: Optional[int] = None,
-             collective: bool = False):
+             collective: bool = False, trace_id: Optional[str] = None,
+             parent: Optional[str] = None):
         """Record one phase. Yields a handle whose ``fence(x)`` arms a
         scalar-fetch sync on ``x`` before the span closes (for targets only
         known inside the block, e.g. the dispatched round's metrics);
@@ -172,7 +187,9 @@ class PhaseSpans:
         the consuming thread. ``collective=True`` tags the span as waiting
         on a cross-chip collective — ``collective_exposure_ms()`` then
         charges any part of it not covered by another span as exposed
-        (un-overlapped) collective time. Yields None when disabled."""
+        (un-overlapped) collective time. ``trace_id=``/``parent=`` stamp
+        the owning round/cohort ids (schema v11; telemetry/trace.py mints
+        them). Yields None when disabled."""
         if not self.enabled:
             yield None
             return
@@ -189,19 +206,44 @@ class PhaseSpans:
                 fenced = True
         finally:
             t1 = time.perf_counter()
-            args = {"step": self._step if step is None else int(step),
-                    "fenced": fenced}
-            if collective:
-                args["collective"] = True
-            self.events.append({
-                "name": name,
-                "ph": "X",
-                "ts": (t0 - self._t0) * 1e6,
-                "dur": (t1 - t0) * 1e6,
-                "pid": 0,
-                "tid": self._lane(),
-                "args": args,
-            })
+            self._record(name, t0, t1, step=step, fenced=fenced,
+                         collective=collective, trace_id=trace_id,
+                         parent=parent)
+
+    def span_at(self, name: str, t0_s: float, t1_s: float,
+                step: Optional[int] = None, collective: bool = False,
+                trace_id: Optional[str] = None,
+                parent: Optional[str] = None) -> None:
+        """Record a span RETROACTIVELY from explicit ``perf_counter``
+        endpoints (seconds, same clock as the recorder's). The asyncfed
+        engine measures a cohort's buffer residency this way: the start is
+        captured at launch, but the interval only becomes a span when the
+        cohort's last share is consumed. No-op when disabled."""
+        if not self.enabled:
+            return
+        self._record(name, float(t0_s), float(t1_s), step=step,
+                     fenced=False, collective=collective,
+                     trace_id=trace_id, parent=parent)
+
+    def _record(self, name, t0, t1, *, step, fenced, collective,
+                trace_id, parent) -> None:
+        args = {"step": self._step if step is None else int(step),
+                "fenced": fenced}
+        if collective:
+            args["collective"] = True
+        if trace_id is not None:
+            args["trace_id"] = str(trace_id)
+            if parent is not None:
+                args["parent"] = str(parent)
+        self.events.append({
+            "name": name,
+            "ph": "X",
+            "ts": (t0 - self._t0) * 1e6,
+            "dur": (t1 - t0) * 1e6,
+            "pid": 0,
+            "tid": self._lane(),
+            "args": args,
+        })
 
     def wrap_iter(self, it, name: str = "data_load"):
         """Yield from ``it``, recording each ``next()`` as one span (the

@@ -153,6 +153,31 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
     from commefficient_tpu.utils.profiling import StepProfiler
 
     profiler = StepProfiler(cfg.profile_dir)
+    if cfg.profile_rounds:
+        # --profile_rounds A-B (telemetry/trace.py ProfilerWindow): a
+        # CLI-chosen jax.profiler capture window, stacked behind the same
+        # profiler facade the engines already drive — no engine changes.
+        # The entry/exit fence syncs on the params so deferred applies /
+        # pending writebacks retire OUTSIDE the captured rounds.
+        import os
+
+        from commefficient_tpu.telemetry.trace import (
+            ProfilerStack,
+            ProfilerWindow,
+        )
+        from commefficient_tpu.utils.profiling import fence
+
+        window_dir = cfg.profile_dir or os.path.join(
+            writer.logdir if writer is not None else cfg.logdir,
+            "profile_rounds",
+        )
+        profiler = ProfilerStack(
+            profiler,
+            ProfilerWindow(
+                cfg.profile_rounds, window_dir,
+                fence_fn=lambda: fence(session.state.params_vec),
+            ),
+        )
     # adaptive-communication controller (control/): None unless the config
     # turns the control plane on. Built BEFORE the telemetry riders (the
     # ledger switches to per-rung accounting, the flight recorder carries
@@ -272,10 +297,11 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
             resil.close()
         raise
 
-    def span(name):
+    def span(name, trace_id=None):
         # one shape for every optional-span site (drain / checkpoint /
         # snapshot) — no-op context when spans are off
-        return spans.span(name) if spans is not None else nullcontext()
+        return (spans.span(name, trace_id=trace_id)
+                if spans is not None else nullcontext())
 
     def ckpt_save(force=False):
         with span("checkpoint"):
@@ -311,7 +337,18 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
                         hooks.accumulate(_a, loss, metrics)
 
                     def drain(_acc=acc):
-                        with span("metric_drain"):
+                        # the drain span names the NEWEST pending round
+                        # (schema v11): the fetch fences through that
+                        # round's device work, so that is the trace the
+                        # drain wait belongs to
+                        tid = None
+                        if pending:
+                            from commefficient_tpu.telemetry.trace import (
+                                round_trace_id,
+                            )
+
+                            tid = round_trace_id(pending[-1][0])
+                        with span("metric_drain", trace_id=tid):
                             drain_round_metrics(pending, writer, _acc,
                                                 ledger=ledger, flight=flight,
                                                 controller=controller)
@@ -475,6 +512,18 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
         if spans is not None:
             session.spans = None
             spans.close()  # dumps spans_<step>.json (crash included)
+            if cfg.run_report and writer is not None:
+                # critical-path run report over the just-dumped spans +
+                # metrics (telemetry/trace.py; schema v11) — best-effort
+                # on crash paths too, a partial report is still evidence
+                from commefficient_tpu.telemetry.trace import (
+                    write_run_report,
+                )
+
+                path = write_run_report(writer.logdir,
+                                        generated_by=generated_by)
+                if path:
+                    print(f"run report: {path}")
         if ledger is not None:
             # partial ledgers are still evidence — write on crash too
             ledger.write(writer.logdir)

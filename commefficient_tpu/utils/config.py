@@ -370,6 +370,13 @@ class Config:
     # AOT compile of the round (seconds at CV scale, minutes for GPT-2) —
     # set false to skip it on huge models where the double compile hurts.
     perf_audit: bool = True
+    # Critical-path run report (telemetry/trace.py build_run_report):
+    # written as run_report.json at train-loop close when telemetry_level
+    # >= 1 — per-stage p50/p95 + attribution fractions + anomaly flags
+    # over the recorded spans. Same opt-out discipline as perf_audit
+    # (accuracy_run passes False so its headers never link a report that
+    # will not exist). Free at level 0 either way (no spans recorder).
+    run_report: bool = True
 
     # --- federated environment simulation (commefficient_tpu/fedsim/;
     # TPU-native — the reference assumes all num_workers arrive every
@@ -571,6 +578,15 @@ class Config:
     tensorboard: bool = False
     logdir: str = "runs"
     profile_dir: str = ""  # jax.profiler trace of a few steady-state rounds
+    # Programmatic jax.profiler capture window over rounds "A-B"
+    # (inclusive; telemetry/trace.py ProfilerWindow): arms start/stop
+    # around exactly those rounds — clamped to the steady-state window
+    # (MIN_WARMUP_STEPS) and fenced so deferred/in-flight work retires
+    # outside the capture — into profile_dir (or <logdir>/profile_rounds
+    # when profile_dir is unset). "" (default) constructs nothing.
+    # Degrades gracefully (logged named reason) where the backend cannot
+    # trace. This is the BENCH_r06 per-op TPU profile hook.
+    profile_rounds: str = ""
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -788,6 +804,15 @@ class Config:
             raise ValueError(
                 f"flight_window must be >= 1, got {self.flight_window}"
             )
+        if self.profile_rounds:
+            # lazy import keeps the no-cycle layering (telemetry never
+            # imports config); parse_profile_rounds raises the ValueError
+            # naming the offending spec
+            from commefficient_tpu.telemetry.trace import (
+                parse_profile_rounds,
+            )
+
+            parse_profile_rounds(self.profile_rounds)
         if self.max_retraces is not None and self.max_retraces < 0:
             raise ValueError(
                 f"max_retraces must be >= 0 (0 = fail on ANY retrace "

@@ -117,6 +117,10 @@ def main():
         # measures accuracy-vs-bytes, not perf; bench.py owns the audited
         # perf numbers
         perf_audit=False,
+        # same opt-out for the critical-path run report: a dozen table
+        # rows would each write a run_report.json into the shared logdir
+        # and ACCURACY.md rows would dangle links to whichever survived
+        run_report=False,
     )
     if args.dropout is not None or args.availability is not None:
         # fedsim partial participation for the whole table (masking forces

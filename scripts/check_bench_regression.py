@@ -110,6 +110,15 @@ TOLERANCES = {
 # stalls make relative tolerances meaningless (0.2 ms vs a 0.1 ms median
 # is +100% of noise), so the stall regression shows up through occupancy
 # and samples/s instead.
+# round-tracing PR: the sketch_traced leg's per-stage
+# sketch_traced_*_exclusive_ms rows and sketch_traced_wall_ms are
+# INFORMATIONAL by the same rule (*_exclusive_ms / *_wall_ms carry no
+# gated suffix) — they measure a fenced-every-round diagnostic loop,
+# wall-clock-excluded from twin comparisons exactly like the
+# xla/exposed_collective_ms family; sketch_traced_critical_stage is a
+# stage NAME (string — never gated by construction). A real attribution
+# regression shows up through the gated headline/pipelined rows, with
+# these rows saying WHICH stage moved.
 LOWER_IS_BETTER_SUFFIXES = ("_sec_per_round",)
 HIGHER_IS_BETTER_KEYS = ("value", "mfu", "vs_baseline")
 HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",

@@ -1,6 +1,6 @@
 """Validate telemetry artifacts against the versioned schema.
 
-The telemetry subsystem writes five artifact kinds per run dir
+The telemetry subsystem writes six artifact kinds per run dir
 (README "Observability" documents the full schema; the version lives in
 ``commefficient_tpu.telemetry.SCHEMA_VERSION``):
 
@@ -25,7 +25,16 @@ The telemetry subsystem writes five artifact kinds per run dir
                             <= the W*k candidate bound and the ledger-vs-
                             HLO byte delta within the recorded tolerance.
   * ``spans_<step>.json`` — host phase spans (v3, telemetry/spans.py) in
-                            Chrome-trace/Perfetto event format.
+                            Chrome-trace/Perfetto event format; v11 adds
+                            the optional args.trace_id/args.parent
+                            correlation fields (rules enforced below)
+  * ``run_report.json``   — critical-path run report (v11,
+                            telemetry/trace.py build_run_report, written
+                            by the train loop's close path and
+                            scripts/analyze_run.py): per-stage exclusive
+                            p50/p95 + attribution fractions summing to 1
+                            and per-round DISJOINT stage times summing to
+                            the round's wall-clock — both enforced here.
 
 Consumers (plotting, run comparison, the driver's ACCURACY tooling) parse
 these blind, so the writers and this checker are pinned to each other by
@@ -84,14 +93,27 @@ from pathlib import Path
 # (client_store host|mmap) ANY exemption is rejected: the hosted round
 # takes cohort rows as arguments, so the strict W*k-class
 # sparse_agg_bound must hold with no [C, D] writeback allowance
-# (enforced below). Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10)
+# (enforced below); v11 (round-tracing PR): trace/* scalar namespace
+# (critical_stage an integer index into the TRACE_STAGES taxonomy, the
+# *_exclusive_ms family finite >= 0 — enforced below), spans events'
+# optional args.trace_id (non-empty string) and args.parent (only legal
+# beside a trace_id, non-empty, != trace_id — enforced below), and the
+# run_report.json artifact (validate_run_report: attribution fractions
+# in [0, 1] summing to ~1, per-round disjoint exclusive stage times
+# summing to the round's wall-clock). Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
                    "control/", "pipeline/", "resilience/", "async/",
-                   "clientstore/")
+                   "clientstore/", "trace/")
+
+# pinned copy of telemetry.trace.STAGES (this checker imports nothing
+# from the package by design — tests/test_telemetry_schema.py pins the
+# two tuples against each other)
+TRACE_STAGES = ("data", "h2d", "dispatch", "collective", "drain",
+                "writeback", "idle")
 
 
 class SchemaError(ValueError):
@@ -356,6 +378,34 @@ def _check_xla_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_trace_scalar(name: str, v, where: str) -> None:
+    """v11 ``trace/*`` value invariants. Host-computed critical-path
+    gauges (telemetry/trace.py CriticalPath), never legitimately
+    non-finite: ``critical_stage`` is the INDEX of the round's binding
+    stage in the TRACE_STAGES taxonomy (an integer by construction);
+    the ``*_exclusive_ms`` family are disjoint interval measures and
+    negative time means the exclusive-assignment subtraction broke."""
+    if not name.startswith("trace/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "trace/critical_stage" and (
+            v != int(v) or not 0 <= v < len(TRACE_STAGES)):
+        raise SchemaError(
+            f"{where}: trace/critical_stage {v} is not an integer index "
+            f"into the {len(TRACE_STAGES)}-stage taxonomy "
+            f"{TRACE_STAGES}"
+        )
+    if name.endswith("_exclusive_ms") and v < 0:
+        raise SchemaError(
+            f"{where}: {name} {v} is negative — exclusive stage times "
+            "are disjoint interval measures, >= 0 by construction"
+        )
+
+
 def _check_recovery_history(hist, where: str) -> None:
     """v6 flight ``recovery_history`` block: one entry per divergence
     rollback, in recovery order."""
@@ -424,6 +474,7 @@ def validate_metrics_jsonl(path) -> int:
             _check_async_scalar(name, rec["value"], where)
             _check_clientstore_scalar(name, rec["value"], where)
             _check_xla_scalar(name, rec["value"], where)
+            _check_trace_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
@@ -611,6 +662,7 @@ def validate_flight(path) -> dict:
             _check_async_scalar(name, v, w)
             _check_clientstore_scalar(name, v, w)
             _check_xla_scalar(name, v, w)
+            _check_trace_scalar(name, v, w)
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step
@@ -908,9 +960,171 @@ def validate_spans(path) -> dict:
                 f"{w}: args.collective must be true when present, got "
                 f"{args['collective']!r}"
             )
+        # v11 trace correlation: trace_id names the owning round/cohort
+        # ("r<step>" / "c<cohort>"); parent is a causal link and only
+        # means something on an id-carrying span — the writer
+        # (telemetry/spans.py _record) never emits a bare parent, so one
+        # here is a writer regression
+        if "trace_id" in args and (
+                not isinstance(args["trace_id"], str)
+                or not args["trace_id"]):
+            raise SchemaError(
+                f"{w}: args.trace_id must be a non-empty string, got "
+                f"{args['trace_id']!r}"
+            )
+        if "parent" in args:
+            if "trace_id" not in args:
+                raise SchemaError(
+                    f"{w}: args.parent without args.trace_id — a parent "
+                    "link rides only on id-carrying spans (schema v11)"
+                )
+            par = args["parent"]
+            if not isinstance(par, str) or not par:
+                raise SchemaError(
+                    f"{w}: args.parent must be a non-empty string, got "
+                    f"{par!r}"
+                )
+            if par == args["trace_id"]:
+                raise SchemaError(
+                    f"{w}: args.parent == args.trace_id ({par!r}) — a "
+                    "span cannot be its own causal parent"
+                )
         n_spans += 1
     if n_spans == 0:
         raise SchemaError(f"{where}: no complete ('X') span events")
+    return rec
+
+
+def validate_run_report(path) -> dict:
+    """Validate a run_report.json (v11, telemetry/trace.py
+    build_run_report) INCLUDING the attribution invariants: stage
+    fractions in [0, 1] summing to ~1 over analyzed rounds (or all zero
+    when nothing was attributed), per-round exclusive stage times
+    finite, >= 0, and summing to the round's wall-clock — the
+    disjointness guarantee CriticalPath makes; an overlap between two
+    stages would push the sum past the wall and fail here."""
+    where = str(path)
+    with open(path) as f:
+        rec = _strict_loads(f.read())
+    _check_version(rec, where)
+    if rec.get("kind") != "run_report":
+        raise SchemaError(f"{where}: kind must be 'run_report', got "
+                          f"{rec.get('kind')!r}")
+    _req(rec, "generated_by", str, where)
+    _req(rec, "sources", dict, where)
+    n_rounds = _req(rec, "rounds_analyzed", int, where)
+    if n_rounds < 0:
+        raise SchemaError(f"{where}: negative rounds_analyzed")
+    crit = _req(rec, "critical_stage", str, where)
+    if crit not in TRACE_STAGES:
+        raise SchemaError(
+            f"{where}: critical_stage {crit!r} outside the stage "
+            f"taxonomy {TRACE_STAGES}"
+        )
+    counts = _req(rec, "critical_counts", dict, where)
+    if set(counts) != set(TRACE_STAGES):
+        raise SchemaError(
+            f"{where}: critical_counts keys {sorted(counts)} != the "
+            "stage taxonomy"
+        )
+    for s, c in counts.items():
+        if isinstance(c, bool) or not isinstance(c, int) or c < 0:
+            raise SchemaError(
+                f"{where}: critical_counts[{s!r}] must be a non-negative "
+                f"integer, got {c!r}"
+            )
+    if sum(counts.values()) != n_rounds:
+        raise SchemaError(
+            f"{where}: critical_counts sum to {sum(counts.values())}, "
+            f"but {n_rounds} round(s) were analyzed — every analyzed "
+            "round has exactly one binding stage"
+        )
+    stages = _req(rec, "stages", dict, where)
+    if set(stages) != set(TRACE_STAGES):
+        raise SchemaError(
+            f"{where}: stages keys {sorted(stages)} != the stage taxonomy"
+        )
+    frac_sum = 0.0
+    for s, blk in stages.items():
+        w = f"{where}:stages[{s}]"
+        if not isinstance(blk, dict):
+            raise SchemaError(f"{w}: expected an object")
+        for f_ in ("p50_ms", "p95_ms", "total_ms"):
+            v = _req(blk, f_, (int, float), w)
+            if isinstance(v, bool) or v < 0:
+                raise SchemaError(f"{w}: {f_} must be >= 0, got {v!r}")
+        fr = _req(blk, "fraction", (int, float), w)
+        if isinstance(fr, bool) or not 0.0 <= fr <= 1.0:
+            raise SchemaError(
+                f"{w}: fraction {fr!r} outside [0, 1]"
+            )
+        frac_sum += fr
+    # fractions are total_ms / total wall per stage, idle the remainder
+    # of every round — so they sum to 1 whenever anything was attributed
+    # (and to exactly 0 for a spans-less report)
+    if frac_sum != 0.0 and abs(frac_sum - 1.0) > 1e-6:
+        raise SchemaError(
+            f"{where}: stage fractions sum to {frac_sum!r}, expected ~1 "
+            "(attribution must account for every analyzed microsecond, "
+            "idle included)"
+        )
+    rounds = _req(rec, "rounds", list, where)
+    if len(rounds) != n_rounds:
+        raise SchemaError(
+            f"{where}: {len(rounds)} per-round entries but "
+            f"rounds_analyzed={n_rounds}"
+        )
+    for j, r in enumerate(rounds):
+        w = f"{where}:rounds[{j}]"
+        if not isinstance(r, dict):
+            raise SchemaError(f"{w}: expected an object")
+        step = _req(r, "step", int, w)
+        if step < 0:
+            raise SchemaError(f"{w}: negative step")
+        wall = _req(r, "wall_ms", (int, float), w)
+        if isinstance(wall, bool) or wall < 0:
+            raise SchemaError(f"{w}: wall_ms must be >= 0, got {wall!r}")
+        rc_ = _req(r, "critical_stage", str, w)
+        if rc_ not in TRACE_STAGES:
+            raise SchemaError(
+                f"{w}: critical_stage {rc_!r} outside the stage taxonomy"
+            )
+        sm = _req(r, "stages_ms", dict, w)
+        if set(sm) != set(TRACE_STAGES):
+            raise SchemaError(
+                f"{w}: stages_ms keys {sorted(sm)} != the stage taxonomy"
+            )
+        tot = 0.0
+        for s, v in sm.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise SchemaError(
+                    f"{w}: stages_ms[{s!r}] must be a number, got {v!r}"
+                )
+            if v < 0:
+                raise SchemaError(
+                    f"{w}: stages_ms[{s!r}] {v} is negative — exclusive "
+                    "stage times are interval measures, >= 0"
+                )
+            tot += v
+        # disjointness: exclusive times sum to EXACTLY the wall-clock
+        # (idle is the remainder); a sum past the wall means two stages
+        # were charged the same microseconds
+        if tot > wall + max(1e-6, 1e-6 * wall):
+            raise SchemaError(
+                f"{w}: exclusive stage times sum to {tot} ms, past the "
+                f"round's wall_ms {wall} — stages overlap (schema v11 "
+                "requires a disjoint decomposition)"
+            )
+    anomalies = _req(rec, "anomalies", list, where)
+    for j, a in enumerate(anomalies):
+        w = f"{where}:anomalies[{j}]"
+        if not isinstance(a, dict):
+            raise SchemaError(f"{w}: expected an object")
+        for f_ in ("kind", "metric", "detail"):
+            if not isinstance(a.get(f_), str) or not a[f_]:
+                raise SchemaError(
+                    f"{w}: anomaly needs a non-empty string {f_!r}"
+                )
     return rec
 
 
@@ -943,6 +1157,11 @@ def validate_run_dir(run_dir) -> dict:
     for spans in sorted(run_dir.glob("spans_*.json")):
         rec = validate_spans(spans)
         out[str(spans)] = f"{len(rec['traceEvents'])} span event(s)"
+    report = run_dir / "run_report.json"
+    if report.exists():
+        rec = validate_run_report(report)
+        out[str(report)] = (f"{rec['rounds_analyzed']} round(s), "
+                            f"critical: {rec['critical_stage']}")
     if not out:
         raise SchemaError(f"{run_dir}: no telemetry artifacts found")
     return out

@@ -66,6 +66,15 @@ def main():
         "round-trip fidelity / powersgd reconstruction residual)",
     )
     ap.add_argument(
+        "--profile_rounds", default="",
+        help="'A-B' inclusive round window arming a programmatic "
+        "jax.profiler capture over the traced ground-truth rounds (the "
+        "same telemetry.trace.ProfilerWindow --profile_rounds wires into "
+        "the train loop: clamped past warmup, fenced at entry/exit, "
+        "degrades with a named reason where the backend cannot trace); "
+        "the trace lands in ./profile_round_trace",
+    )
+    ap.add_argument(
         "--d", type=int, default=0,
         help="override the sketch dimension for the phase split (0 = the "
         "ResNet-9 D). Set 124_000_000 to run the decode phases at GPT-2 "
@@ -498,6 +507,51 @@ def main():
     dt_loop = (time.perf_counter() - t0) / n * 1e3
     print(f"per-round dispatch [{tag}]: {dt_loop:.2f} ms -> "
           f"{workers * bench_batch / dt_loop * 1e3:,.0f} samples/s")
+    # -- critical path (round-tracing PR) ----------------------------------
+    # a SEPARATE n-round loop with a PhaseSpans recorder and a per-round
+    # fence, decomposed by the SAME CriticalPath analyzer the run reports
+    # use (telemetry/trace.py — reused, not reimplemented). The per-round
+    # fence makes each dispatch span the true device+host round latency,
+    # so this loop is slower than the free-running line above by design.
+    # --profile_rounds A-B arms a programmatic jax.profiler capture
+    # window over exactly these rounds.
+    try:
+        from commefficient_tpu.telemetry.spans import PhaseSpans
+        from commefficient_tpu.telemetry.trace import (
+            STAGES, CriticalPath, ProfilerWindow, round_trace_id,
+        )
+
+        spans = PhaseSpans(".", start_step=2, num_steps=n)
+        window = None
+        if args.profile_rounds:
+            window = ProfilerWindow(
+                args.profile_rounds, "profile_round_trace",
+                fence_fn=lambda: fence(state.params_vec))
+        for i in range(n):
+            step = 2 + i
+            spans.step(step)
+            if window is not None:
+                window.step(step)
+            with spans.span("round_dispatch", collective=True, step=step,
+                            trace_id=round_trace_id(step)) as sp:
+                state, m = round_fn(state, ids, data, jnp.float32(0.1))
+                sp.fence(m["loss"])
+        if window is not None:
+            window.step(2 + n)
+            window.close()
+        cp = CriticalPath(spans.events)
+        bds = [bd for bd in (cp.round_breakdown(s) for s in cp.steps())
+               if bd is not None]
+        wall = sum(bd["wall_ms"] for bd in bds) / len(bds)
+        tot = {s: sum(bd["stages_ms"][s] for bd in bds) / len(bds)
+               for s in STAGES}
+        crit = max(STAGES, key=lambda s: tot[s])
+        parts = " + ".join(f"{s} {tot[s]:.2f}" for s in STAGES
+                           if tot[s] > 0)
+        print(f"[critical path] {len(bds)} fenced round(s): {parts} "
+              f"= {wall:.2f} ms/round; binding stage: {crit}")
+    except Exception as e:  # noqa: BLE001 — lab line, never kills the run
+        print(f"[critical path] unavailable: {e}")
     # layerwise-overlap twin (hide-the-collectives PR): the same round
     # with the aggregation psum and the top-k gathers split into
     # per-leaf-group segments (--overlap_collectives layerwise) so XLA

@@ -362,3 +362,30 @@ def test_overlap_metrics_registered_and_gated(tmp_path):
         [good], {**good, "sketch_overlap_layerwise_vs_sequential": 1.05,
                  "async_double_buffered_vs_sequential": 1.12})
     assert regs == []
+
+
+def test_sketch_traced_rows_are_informational(tmp_path):
+    """Round-tracing PR: the sketch_traced_* critical-path rows ride the
+    matrix for attribution (which stage moved), never for gating —
+    no exclusive-time family or stage-name string may acquire a gated
+    suffix, and wildly different attribution between rounds must not
+    fail the gate (a real regression still gates via the headline)."""
+    mod = _gate()
+    for name in ("sketch_traced_wall_ms", "sketch_traced_data_exclusive_ms",
+                 "sketch_traced_collective_exclusive_ms",
+                 "sketch_traced_idle_exclusive_ms",
+                 "sketch_traced_critical_stage", "sketch_traced_rounds",
+                 "sketch_traced_error"):
+        assert mod.metric_direction(name) is None, name
+    good = {**BASELINE, "sketch_traced_wall_ms": 12.0,
+            "sketch_traced_critical_stage": "collective",
+            "sketch_traced_collective_exclusive_ms": 8.0}
+    moved = {**BASELINE, "sketch_traced_wall_ms": 50.0,
+             "sketch_traced_critical_stage": "h2d",
+             "sketch_traced_collective_exclusive_ms": 0.5}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", moved)
+    assert mod.main(["--dir", str(tmp_path)]) == 0
+    # the detects-regression guard still bites with traced rows present
+    regs, _, _ = mod.check_regression([good], {**moved, "value": 19000.0})
+    assert [r["metric"] for r in regs] == ["value"]

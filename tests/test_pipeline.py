@@ -578,8 +578,8 @@ def _scalar_sequence(logdir, *, exclude_prefix="pipeline/"):
     in file order — the bit-exactness comparison unit (wall-time ``t`` is
     the only field that may differ between twin runs). ``pipeline/*`` is
     excluded: those gauges exist only at depth > 0 by design, and
-    ``xla/exposed_collective_ms`` (v9) because it is the stream's one
-    host-measured wall-clock scalar."""
+    ``xla/exposed_collective_ms`` (v9) plus ``trace/*`` (v11) because
+    they are host-measured wall-clock attribution."""
     out = []
     for root, _, files in os.walk(logdir):
         for f in sorted(files):
@@ -591,7 +591,7 @@ def _scalar_sequence(logdir, *, exclude_prefix="pipeline/"):
                     if "name" not in rec:
                         continue  # run header
                     if rec["name"].startswith(
-                        (exclude_prefix, "xla/exposed_collective_ms")
+                        (exclude_prefix, "trace/", "xla/exposed_collective_ms")
                     ):
                         continue
                     out.append((rec["name"], rec["value"], rec["step"]))

@@ -297,13 +297,14 @@ def _run_loop(tmp_path, tag, ckpt_kw=None, table=None, **kw):
 
 
 def _scalars(run_dir,
-             exclude=("resilience/", "xla/exposed_collective_ms")):
+             exclude=("resilience/", "trace/",
+                      "xla/exposed_collective_ms")):
     """metrics.jsonl as (name, value, step) in file order, deduped to the
     LAST occurrence per (name, step): a recovery replays its rolled-back
     rounds, so those steps legitimately appear twice — the healed values
     are the survivors the determinism contract compares.
-    ``xla/exposed_collective_ms`` (v9) is the stream's one wall-clock
-    scalar — host-measured, so excluded from bit-equality twins."""
+    ``xla/exposed_collective_ms`` (v9) and ``trace/*`` (v11) are
+    host-measured wall-clock, so excluded from bit-equality twins."""
     rows = {}
     with open(os.path.join(run_dir, "metrics.jsonl")) as f:
         for line in f:
@@ -406,7 +407,7 @@ def test_retry_heals_under_pipelined_engine(tmp_path, uninterrupted):
     assert _last_value(run_dir, "resilience/recoveries") == 1.0
     # pipeline/* gauges exist only at depth > 0 — exclude them from the
     # cross-depth scalar comparison, like tests/test_pipeline.py does
-    seq = _scalars(run_dir, exclude=("resilience/", "pipeline/",
+    seq = _scalars(run_dir, exclude=("resilience/", "pipeline/", "trace/",
                                      "xla/exposed_collective_ms"))
     assert seq == uninterrupted["scalars"]
 

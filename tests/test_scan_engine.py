@@ -161,10 +161,11 @@ def _scalar_sequence(logdir):
                     if "name" not in rec:
                         continue
                     if rec["name"].startswith(
-                        ("pipeline/", "xla/exposed_collective_ms")
+                        ("pipeline/", "trace/", "xla/exposed_collective_ms")
                     ):
                         # scan gauges exist only at K > 1; the exposure
-                        # scalar (v9) is wall-clock, never bit-equal
+                        # scalar (v9) and trace/* attribution (v11) are
+                        # host wall-clock, never bit-equal
                         continue
                     out.append((rec["name"], rec["value"], rec["step"]))
     return out

@@ -355,6 +355,9 @@ def _run_cv_main(tmp_path, **mode_kw):
     return names
 
 
+@pytest.mark.slow  # ~52s ResNet-9 compile: tier-1 budget (PR 18) — the
+# level-2 scalar surface stays tier-1 via the TinyMLP tests above, and
+# cv_train e2e + validate_run_dir via test_train_entry/test_fedsim
 def test_cv_train_telemetry_level2_end_to_end(tmp_path):
     """The real CLI->Config->round->drain->ledger path at --telemetry_level
     2 (local_topk: the cheapest CPU mode at ResNet-9 scale — the per-mode

@@ -861,3 +861,178 @@ def test_v10_perf_report_rejects_hosted_exemption(tmp_path):
 
     tampered(unknown_marker, "unknown sparse_agg_exemption")
     tampered(host_with_exemption, "hosts client state")
+
+
+# ---------------------------------------------------------------------------
+# v11: trace/* scalars, span trace ids, and the run report
+# ---------------------------------------------------------------------------
+
+def test_v11_trace_scalars_validate_and_reject(tmp_path):
+    """The trace/ critical-path prefix is in-schema through the REAL
+    writer; the index/interval invariants are enforced on both scalar
+    paths (metrics.jsonl and the flight recorder's metric blocks). The
+    end-to-end form — these scalars riding a traced run's metrics — is
+    pinned by tests/test_trace.py."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, num_workers=8,
+                 num_devices=8)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        # the lagged emission's zeros row, then a real attribution
+        writer.scalar("trace/critical_stage", 6.0 if s < 2 else 3.0, s)
+        writer.scalar("trace/collective_exclusive_ms",
+                      0.0 if s < 2 else 1.25, s)
+        writer.scalar("trace/idle_exclusive_ms", 0.0, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 15
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "trace/idle_exclusive_ms", "value": -0.5, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "trace/dispatch_exclusive_ms", "value": -2.0, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "trace/critical_stage", "value": 3.5, "step": 0,
+          "t": 1.0}, "integer index"),
+        ({"name": "trace/critical_stage", "value": -1.0, "step": 0,
+          "t": 1.0}, "integer index"),
+        ({"name": "trace/critical_stage", "value": 7.0, "step": 0,
+          "t": 1.0}, "integer index"),
+        ({"name": "trace/critical_stage", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+    # same invariants hold on the flight recorder's metric blocks
+    flight = FlightRecorder(cfg, logdir=str(tmp_path))
+    for s in range(3):
+        flight.record(s, 0.1, {"loss": 1.0, "trace/critical_stage": 6.0,
+                               "trace/idle_exclusive_ms": 0.25})
+    fpath = flight.dump(2, reason="test dump", first_bad_step=2)
+    mod.validate_flight(fpath)
+
+    def tampered(mutate, msg):
+        with open(fpath) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_flight.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_flight(bad)
+
+    tampered(lambda r: r["records"][0]["scalars"].update(
+        {"trace/idle_exclusive_ms": -1.0}), "negative")
+    tampered(lambda r: r["records"][0]["scalars"].update(
+        {"trace/critical_stage": 2.5}), "integer index")
+
+
+def test_v11_spans_trace_id_rules(tmp_path):
+    """Span trace correlation through the REAL recorder: a cohort span
+    with a round parent validates; an empty trace_id, a bare parent
+    (no trace_id), and a self-parented span are rejected."""
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+
+    mod = _checker()
+    spans = PhaseSpans(str(tmp_path))
+    spans.step(2)
+    with spans.span("round_dispatch", trace_id="r2"):
+        pass
+    with spans.span("async_launch", step=2, trace_id="c1", parent="r2"):
+        pass
+    with spans.span("metric_drain"):  # correlation is OPTIONAL per span
+        pass
+    path = spans.close()
+    rec = mod.validate_spans(path)
+    evs = [e for e in rec["traceEvents"] if e["ph"] == "X"]
+    assert {e["args"].get("trace_id") for e in evs} == {"r2", "c1", None}
+    launch = next(e for e in evs if e["name"] == "async_launch")
+    assert launch["args"]["parent"] == "r2"
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_spans.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_spans(bad)
+
+    def x_events(r):
+        return [e for e in r["traceEvents"] if e["ph"] == "X"]
+
+    tampered(lambda r: x_events(r)[0]["args"].update(trace_id=""),
+             "non-empty string")
+    tampered(lambda r: x_events(r)[2]["args"].update(parent="r9"),
+             "without args.trace_id")
+    tampered(lambda r: x_events(r)[1]["args"].update(parent="c1"),
+             "own causal parent")
+
+
+def test_v11_run_report_validates_and_rejects(tmp_path):
+    """The run report through the REAL builder (telemetry/trace.py) over
+    a real spans dump, then the attribution invariants: overlapping
+    stage intervals (exclusive sums past the wall), negative stage
+    times, a broken binding-stage count, and off-taxonomy stages are
+    all caught — the checker cannot rot into a vacuous pass."""
+    from commefficient_tpu.telemetry.spans import PhaseSpans
+    from commefficient_tpu.telemetry.trace import write_run_report
+
+    mod = _checker()
+    spans = PhaseSpans(str(tmp_path))
+    for s in range(2):
+        spans.step(s)
+        with spans.span("device_put", step=s, trace_id=f"r{s}"):
+            pass
+        with spans.span("round_dispatch", step=s, collective=True,
+                        trace_id=f"r{s}"):
+            pass
+    spans.close()
+    path = write_run_report(str(tmp_path), generated_by="schema test")
+    rec = mod.validate_run_report(path)
+    assert rec["rounds_analyzed"] == 2
+    # the run-dir walk picks the report up alongside the spans dump
+    walk = mod.validate_run_dir(str(tmp_path))
+    assert any(p.endswith("run_report.json") for p in walk)
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_report.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_run_report(bad)
+
+    def overlap(r):
+        # charge the same microseconds twice: the exclusive sums now
+        # exceed the round's wall-clock
+        r["rounds"][0]["stages_ms"]["data"] += \
+            r["rounds"][0]["wall_ms"] + 1.0
+
+    tampered(overlap, "stages overlap")
+    tampered(lambda r: r["rounds"][0]["stages_ms"].update(h2d=-0.25),
+             "negative")
+    tampered(lambda r: r["rounds"][0].update(critical_stage="turbo"),
+             "outside the stage taxonomy")
+    tampered(lambda r: r.update(critical_stage="turbo"),
+             "outside the stage taxonomy")
+    tampered(lambda r: r["critical_counts"].update(idle=5),
+             "critical_counts sum")
+    tampered(lambda r: r["critical_counts"].pop("idle"),
+             "stage taxonomy")
+    tampered(lambda r: r["stages"]["idle"].update(fraction=0.9),
+             "fractions sum")
+    tampered(lambda r: r["stages"]["idle"].update(p50_ms=-1.0),
+             ">= 0")
+    tampered(lambda r: r.update(rounds=r["rounds"][:1]),
+             "per-round entries")
+    tampered(lambda r: r.update(kind="bench"), "kind must be")

@@ -32,6 +32,9 @@ def test_cv_train_femnist_end_to_end(tmp_path):
     assert 0.0 <= val["accuracy"] <= 1.0
 
 
+@pytest.mark.slow  # ~37s ResNet-9 compile: tier-1 budget (PR 18) — a
+# mode-twin of the femnist e2e above; powersgd algebra and round parity
+# keep their own tier-1 coverage in tests/test_powersgd.py
 def test_cv_train_powersgd_end_to_end(tmp_path):
     """PR 2 acceptance: mode=powersgd trains end-to-end through the real
     cv_train entry (CLI flags -> Config -> compress/ registry -> round),
