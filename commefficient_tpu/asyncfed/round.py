@@ -42,7 +42,10 @@ import jax.numpy as jnp
 
 from commefficient_tpu.compress import get_compressor
 from commefficient_tpu.ops.countsketch import CountSketch
-from commefficient_tpu.parallel.mesh import WORKERS
+from commefficient_tpu.parallel.mesh import (
+    worker_axes,
+    worker_axis_size,
+)
 from commefficient_tpu.parallel.round import (
     FedState,
     make_aggregate_tail,
@@ -95,10 +98,14 @@ def build_async_round_fns(
     lm = cfg.local_momentum
     use_fedsim = bool(cfg.fedsim_enabled)
     grad_one = make_grad_one(cfg, loss_fn, unravel, mesh)
-    Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
+    # multihost meshes: every collective and shard spec below rides the
+    # (HOSTS, WORKERS) tuple, same resolution as the synchronous round
+    axes = worker_axes(mesh)
+    Wd = worker_axis_size(mesh)
     plan = resolve_aggregation(cfg, comp, Wd)
     per_client = make_per_client(cfg, comp, grad_one, use_fedsim=use_fedsim)
-    aggregate_tail = make_aggregate_tail(cfg, comp, plan, W=W, Wd=Wd, d=d)
+    aggregate_tail = make_aggregate_tail(cfg, comp, plan, W=W, Wd=Wd, d=d,
+                                         axes=axes)
     decode_mapped = make_decode_mapped(cfg, comp, mesh, plan, d=d, Wd=Wd)
 
     # ---- launch: the per-client half of worker_shard ---------------------
@@ -106,14 +113,14 @@ def build_async_round_fns(
                      lr, *fs):
         # same vma discipline as the synchronous worker shard: varying
         # params keep AD shard-local so each client sees its own gradient
-        params_vec = pcast(params_vec, WORKERS, to="varying")
+        params_vec = pcast(params_vec, axes, to="varying")
         return jax.vmap(
             lambda b, cid, vel, err, *fs_: per_client(
                 params_vec, b, cid, vel, err, rng, lr, *fs_
             )
         )(batch, client_ids, vel_rows, err_rows, *fs)
 
-    shard_spec = P(WORKERS)
+    shard_spec = P(axes)
     in_specs = (P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P())
     if use_fedsim:
         in_specs = in_specs + (shard_spec, shard_spec)  # live mask, corrupt

@@ -1,0 +1,76 @@
+"""Multi-host bring-up — from env vars to a validated global mesh.
+
+The one entry every multi-host process runs before touching a device:
+
+    joined = initialize_multihost(cfg)   # jax.distributed, if configured
+    mesh = make_global_mesh(cfg)         # (hosts, workers, model, seq)
+
+``initialize_multihost`` wraps ``parallel.mesh.initialize_distributed``
+(the env-driven ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+``JAX_PROCESS_ID`` bring-up) and adds the config cross-checks that turn a
+silent mis-deployment into a named error: a ``--distributed`` run whose
+coordinator env is missing, or a joined pod whose process count disagrees
+with ``--num_hosts``. The mesh-faked CI twin (``num_hosts > 1`` on ONE
+process with virtual devices) never calls ``jax.distributed`` — it takes
+the same ``make_global_mesh`` path with ``jax.process_count() == 1``.
+
+``tests/multihost_child.py`` is the real-2-process consumer; the train
+entries call this unconditionally (both functions are no-ops-with-checks
+on single-host configs).
+"""
+
+from __future__ import annotations
+
+from commefficient_tpu.parallel.mesh import (
+    initialize_distributed,
+    make_mesh,
+)
+
+
+def initialize_multihost(cfg) -> bool:
+    """Join the pod if the config asks for it; return whether a
+    multi-process cluster is up.
+
+    * ``cfg.distributed`` False: touches nothing, returns False — the
+      mesh-faked twin and every single-host run land here.
+    * ``cfg.distributed`` True: runs the env-driven
+      ``jax.distributed.initialize`` bring-up and fails LOUDLY if the
+      coordinator env is absent (the alternative is a one-process run
+      silently pretending to be a pod) or if the joined process count
+      disagrees with ``cfg.num_hosts``.
+    """
+    if not getattr(cfg, "distributed", False):
+        return False
+    joined = initialize_distributed()
+    if not joined:
+        raise RuntimeError(
+            "--distributed was set but no multi-host coordinator is "
+            "configured: export JAX_COORDINATOR_ADDRESS + "
+            "JAX_NUM_PROCESSES + JAX_PROCESS_ID (or run under a TPU pod "
+            "runtime that auto-detects), or drop --distributed to run "
+            "mesh-faked on one process"
+        )
+    import jax
+
+    nproc = jax.process_count()
+    if nproc != cfg.num_hosts:
+        raise ValueError(
+            f"joined a {nproc}-process cluster but --num_hosts is "
+            f"{cfg.num_hosts}: the mesh's host axis must coincide with "
+            "process boundaries (one mesh host row per process) — set "
+            f"--num_hosts {nproc}"
+        )
+    return True
+
+
+def make_global_mesh(cfg):
+    """The run's global mesh from the config — ``(hosts, workers, model,
+    seq)`` when ``cfg.num_hosts > 1``, the unchanged 3-axis mesh
+    otherwise. Call AFTER :func:`initialize_multihost` so ``jax.devices()``
+    spans the pod."""
+    return make_mesh(
+        cfg.num_devices,
+        cfg.model_axis,
+        cfg.seq_axis,
+        hosts=cfg.num_hosts,
+    )

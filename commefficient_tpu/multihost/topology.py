@@ -1,0 +1,146 @@
+"""HostTopology — who owns which chips, worker slots, and clients.
+
+The multi-host run is described by ONE number in the config
+(``cfg.num_hosts``); everything else is derived here so every subsystem
+agrees on the layout:
+
+* **chips**: the global mesh is ``(hosts, workers, model, seq)``
+  (``parallel/mesh.py make_mesh(hosts=)``) — host ``h`` owns the
+  ``num_devices / num_hosts`` consecutive devices of the process-major
+  ``jax.devices()`` order, so on a real pod the host axis coincides with
+  process boundaries, and on the mesh-faked CI twin it is ``num_hosts``
+  contiguous groups of the one process's virtual devices.
+* **worker slots**: the round's ``[num_workers]`` cohort dimension splits
+  host-major — host ``h`` owns slots ``[h * W/H, (h+1) * W/H)``. Because
+  ``P((HOSTS, WORKERS))`` places rows in the same flat device order as the
+  3-axis ``P(WORKERS)``, a host's slot range lands exactly on its chips.
+* **clients**: the client population partitions contiguously by host
+  (``client_partition``) — host ``h`` draws its cohort slots from (and
+  banks clientstore rows for) only its own range, so no client row ever
+  needs to cross DCN (the PR 17 "per-host stores sharded by client
+  partition" remainder).
+
+Pure host-side python over static config ints — nothing here touches a
+device, so topology objects are free to build anywhere (tests build one
+per virtual host on a single process).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from commefficient_tpu.parallel.mesh import HOSTS
+
+
+def slot_partition(num_workers: int, num_hosts: int,
+                   host_id: int) -> Tuple[int, int]:
+    """Host ``host_id``'s half-open range of global worker slots.
+
+    Host-major contiguous split, matching the mesh's
+    ``P((HOSTS, WORKERS))`` row placement — requires the divisibility the
+    config validator already enforced.
+    """
+    if num_workers % num_hosts:
+        raise ValueError(
+            f"num_workers ({num_workers}) must be divisible by num_hosts "
+            f"({num_hosts}) — the config validator enforces this"
+        )
+    per = num_workers // num_hosts
+    if not 0 <= host_id < num_hosts:
+        raise ValueError(f"host_id {host_id} not in [0, {num_hosts})")
+    return host_id * per, (host_id + 1) * per
+
+
+def client_partition(num_clients: int, num_hosts: int,
+                     host_id: int) -> Tuple[int, int]:
+    """Host ``host_id``'s half-open range of client ids.
+
+    Contiguous, balanced to within one: the first ``num_clients %
+    num_hosts`` hosts get the extra client each — every client is owned
+    by exactly one host and the union covers ``[0, num_clients)``.
+    """
+    if not 0 <= host_id < num_hosts:
+        raise ValueError(f"host_id {host_id} not in [0, {num_hosts})")
+    base, extra = divmod(num_clients, num_hosts)
+    lo = host_id * base + min(host_id, extra)
+    return lo, lo + base + (1 if host_id < extra else 0)
+
+
+@dataclass(frozen=True)
+class HostTopology:
+    """One host's slice of the pod — the value every per-host component
+    (data plane, client bank, bring-up checks) is constructed from."""
+
+    num_hosts: int
+    host_id: int
+    num_workers: int       # GLOBAL cohort size (cfg.num_workers)
+    num_clients: int       # GLOBAL client population
+    chips_per_host: int    # devices on this host's mesh rows
+    slot_range: Tuple[int, int]    # global worker slots this host owns
+    client_range: Tuple[int, int]  # global client ids this host owns
+
+    @property
+    def workers_per_host(self) -> int:
+        lo, hi = self.slot_range
+        return hi - lo
+
+    @property
+    def clients_per_host(self) -> int:
+        lo, hi = self.client_range
+        return hi - lo
+
+    def owns_client(self, client_id: int) -> bool:
+        lo, hi = self.client_range
+        return lo <= int(client_id) < hi
+
+    def local_client(self, client_id: int) -> int:
+        """Global client id -> this host's bank row index."""
+        lo, hi = self.client_range
+        c = int(client_id)
+        if not lo <= c < hi:
+            raise ValueError(
+                f"client {c} is outside host {self.host_id}'s partition "
+                f"[{lo}, {hi}) — per-host banks only store the owning "
+                "host's rows (multihost/topology.py client_partition)"
+            )
+        return c - lo
+
+
+def build_topology(cfg, host_id: Optional[int] = None) -> HostTopology:
+    """This host's :class:`HostTopology` from the config.
+
+    ``host_id`` defaults to ``jax.process_index()`` — correct on a real
+    pod where the mesh's host axis coincides with process boundaries.
+    Mesh-faked runs (N virtual hosts on one process) MUST pass it
+    explicitly, once per virtual host.
+    """
+    if host_id is None:
+        import jax
+
+        host_id = jax.process_index()
+    h = int(host_id)
+    n = int(cfg.num_hosts)
+    return HostTopology(
+        num_hosts=n,
+        host_id=h,
+        num_workers=int(cfg.num_workers),
+        num_clients=int(cfg.num_clients),
+        chips_per_host=int(cfg.num_devices) // n,
+        slot_range=slot_partition(int(cfg.num_workers), n, h),
+        client_range=client_partition(int(cfg.num_clients), n, h),
+    )
+
+
+def validate_mesh_topology(mesh, topology: HostTopology) -> None:
+    """Reject a mesh whose host axis disagrees with the topology — the
+    one cross-check between the two derivation paths (config ints here,
+    ``make_mesh(hosts=)`` there)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    mesh_hosts = sizes.get(HOSTS, 1)
+    if mesh_hosts != topology.num_hosts:
+        raise ValueError(
+            f"mesh declares {mesh_hosts} host(s) but the topology was "
+            f"built for {topology.num_hosts} — build both from the same "
+            "config (make_mesh(hosts=cfg.num_hosts) + build_topology(cfg))"
+        )

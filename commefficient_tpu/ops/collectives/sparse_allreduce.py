@@ -166,7 +166,7 @@ def sparse_allreduce(v: Array, capacity: int, axis_name: str,
 
 
 def sparse_allreduce_sharded(v: Array, k: int, axis_name: str, *,
-                             axis_size: int) -> Array:
+                             axis_size: int, axis_sizes=None) -> Array:
     """Reduce-scatter a ≤k-sparse dense [d] vector across ``axis_name``
     via recursive-halving ``ppermute`` pair exchange.
 
@@ -179,7 +179,21 @@ def sparse_allreduce_sharded(v: Array, k: int, axis_name: str, *,
     ``axis_size`` must be the DECLARED mesh axis size (a power of two for
     the hypercube schedule); the permutation tables are derived from it,
     never hardcoded.
+
+    Multi-host meshes (multihost/): pass the ``(HOSTS, WORKERS)`` tuple
+    as ``axis_name`` plus ``axis_sizes=(H, W_local)`` and the schedule
+    becomes TWO-LEVEL — the intra-host hypercube bits run first (cheap
+    ICI hops while buffer capacities are smallest), then the cross-host
+    bits (DCN hops carry the already-halved index ranges).  Total hop
+    count stays log2(axis_size); the returned slice for flat chip
+    ``h·W_local + w`` is identical to the single-axis schedule's (both
+    equal slicing the psum, up to f32 summation order).
     """
+    if isinstance(axis_name, (tuple, list)):
+        return _sparse_allreduce_sharded_two_level(
+            v, k, tuple(axis_name),
+            axis_size=axis_size, axis_sizes=axis_sizes,
+        )
     # lint: allow[traced-purity] axis_size is the static mesh axis size
     n_dev = int(axis_size)
     if n_dev <= 0 or (n_dev & (n_dev - 1)) != 0:
@@ -215,3 +229,81 @@ def sparse_allreduce_sharded(v: Array, k: int, axis_name: str, *,
         cap = min(cap * 2, dp)  # accumulated sparsity doubles per step
         bit >>= 1
     return jax.lax.dynamic_slice(acc, (start,), (shard,))
+
+
+def _sparse_allreduce_sharded_two_level(v: Array, k: int, axis_name, *,
+                                        axis_size: int, axis_sizes) -> Array:
+    """The two-level hop schedule behind ``sparse_allreduce_sharded`` on a
+    ``(hosts, workers)`` axis tuple — intra-host hypercube bits first,
+    then cross-host.
+
+    The single-axis schedule tracks one contiguous kept range, which
+    forces high-bit-first ordering; here the kept set is a boolean mask
+    over coordinate blocks instead, which admits ANY bit order while
+    preserving the identity chip↔range mapping consumers rely on (chip
+    with flat index m ends holding block m — the slice ``axis_index``
+    locates).  At the step for flat bit b, a chip sends exactly the kept
+    coords whose owning block differs from its own index at b, to the
+    partner differing at that one bit: ``ppermute`` over the WORKERS
+    axis for intra-host bits (b < W_local), over the HOSTS axis for
+    cross-host bits (b = hb·W_local).  After all log2(axis_size) steps
+    the kept set is precisely the chip's own block.
+    """
+    if axis_sizes is None or len(axis_name) != 2 or len(axis_sizes) != 2:
+        raise ValueError(
+            "two-level sparse_allreduce_sharded needs a 2-axis tuple "
+            f"axis_name with matching axis_sizes=(hosts, workers); got "
+            f"axis_name={axis_name!r}, axis_sizes={axis_sizes!r}"
+        )
+    # lint: allow[traced-purity] axis sizes are static mesh axis sizes
+    n_hi, n_lo = (int(s) for s in axis_sizes)
+    for n in (n_hi, n_lo):
+        if n <= 0 or (n & (n - 1)) != 0:
+            raise ValueError(
+                f"two-level sparse_allreduce_sharded needs power-of-two "
+                f"axis sizes for the hypercube schedule, got {axis_sizes}"
+            )
+    n_dev = n_hi * n_lo
+    if int(axis_size) != n_dev:
+        raise ValueError(
+            f"axis_size={axis_size} != product of axis_sizes {axis_sizes}"
+        )
+    d = v.shape[0]
+    shard = -(-d // n_dev)
+    dp = shard * n_dev
+    # lint: allow[traced-purity] k is a static Python int by contract
+    cap = min(int(k), dp)
+    acc = jnp.pad(v, (0, dp - d))
+    # flat chip index over the tuple: host-major, identical to the
+    # single-axis index of the same device order (mesh.make_mesh keeps
+    # the device order unchanged between the 3- and 4-axis forms)
+    me = jax.lax.axis_index(axis_name)
+    blocks = jnp.arange(dp, dtype=jnp.int32) // shard  # owning block per coord
+    kept = jnp.ones((dp,), bool)
+    # static hop schedule: intra-host (low) flat bits first, then
+    # cross-host (high) — log2(n_lo) + log2(n_hi) == log2(n_dev) steps.
+    # Partner tables come from the declared axis sizes, never literals.
+    steps = []
+    b = 1
+    while b < n_lo:
+        steps.append((axis_name[1], [(i, i ^ b) for i in range(n_lo)], b))
+        b <<= 1
+    hb = 1
+    while hb < n_hi:
+        steps.append(
+            (axis_name[0], [(i, i ^ hb) for i in range(n_hi)], hb * n_lo)
+        )
+        hb <<= 1
+    for hop_axis, perm, bit in steps:
+        # send: kept coords whose owner differs from me at this bit —
+        # exactly the partner's half of my kept set
+        diff = ((blocks ^ me) & bit) != 0
+        send = kept & diff
+        idx, val = compact_nonzero(jnp.where(send, acc, 0.0), cap)
+        r_idx = jax.lax.ppermute(idx, hop_axis, perm)
+        r_val = jax.lax.ppermute(val, hop_axis, perm)
+        # the sent coords now belong to the partner; fold in what arrived
+        acc = jnp.where(send, 0.0, acc).at[r_idx].add(r_val)
+        kept = kept & ~diff
+        cap = min(cap * 2, dp)  # accumulated sparsity doubles per step
+    return jax.lax.dynamic_slice(acc, (me * shard,), (shard,))

@@ -32,9 +32,9 @@ from commefficient_tpu.fedsim import build_environment
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import ravel_params
 from commefficient_tpu.parallel.mesh import (
-    WORKERS,
     make_mesh,
     replicated,
+    worker_axis_size,
     worker_sharding,
 )
 from commefficient_tpu.parallel.round import (
@@ -123,7 +123,8 @@ class FederatedSession:
         self.mesh = (
             mesh
             if mesh is not None
-            else make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis)
+            else make_mesh(cfg.num_devices, cfg.model_axis, cfg.seq_axis,
+                           hosts=cfg.num_hosts)
         )
         self._loss_fn = loss_fn
         vec, unravel = ravel_params(params)
@@ -262,12 +263,11 @@ class FederatedSession:
         )
         self._batch_sharding = worker_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
-        # eval batches shard their rows over the WORKERS axis only (they
+        # eval batches shard their rows over the worker axes only (they
         # stay replicated over any model/seq axes), so row divisibility is
-        # against the workers-axis size, not the whole mesh
-        self._n_mesh_devices = dict(
-            zip(self.mesh.axis_names, self.mesh.devices.shape)
-        )[WORKERS]
+        # against the worker-axes size — the (hosts x workers) product on
+        # a multi-host mesh — not the whole mesh
+        self._n_mesh_devices = worker_axis_size(self.mesh)
         # Commit the state to the mesh's replicated sharding up front: the
         # jitted round outputs mesh-sharded arrays, and a first call fed
         # SingleDeviceSharding inputs compiles a SECOND program whose
@@ -437,7 +437,7 @@ class FederatedSession:
         # bench/profiling/tests can report which decode a session compiled
         # without re-deriving the auto rule. FSDP rounds have their own
         # (always-sharded) extraction, so the knob is moot there.
-        _ws = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))[WORKERS]
+        _ws = worker_axis_size(self.mesh)
         decode_resolved = (
             "sharded"
             if not rcfg.fsdp and compressor.use_sharded_decode(_ws)
@@ -1002,6 +1002,28 @@ class FederatedSession:
                 stats["xla/exposed_collective_ms"] = exposed_collective_ms(
                     self.spans, self.last_audit
                 )
+            if self.cfg.num_hosts > 1:
+                # multihost/* scalars (schema v12): process topology plus
+                # the cross-host traffic/exposure attribution. Emitted
+                # only on multi-host configs — num_hosts is fixed for a
+                # run, so the key set stays constant (pack_metric_dicts).
+                # On the mesh-faked twin process_count() is 1 and host_id
+                # 0; the real pod reports its jax.distributed topology.
+                stats["multihost/num_processes"] = float(jax.process_count())
+                stats["multihost/host_id"] = float(jax.process_index())
+                # every aggregation collective rides the declared host
+                # axis, so the round's whole upload payload crosses (or
+                # on one process, would cross) the host boundary once
+                stats["multihost/cross_host_bytes"] = float(
+                    self.bytes_per_round()["upload_bytes"]
+                )
+                # exposed collective wait attributed to DCN: with the
+                # worker collectives spanning the host axis, un-hidden
+                # collective time IS cross-host exposure (0.0 below
+                # spans attachment, same as xla/exposed_collective_ms)
+                stats["multihost/dcn_exposed_ms"] = float(
+                    stats.get("xla/exposed_collective_ms", 0.0)
+                )
         if self.controller is not None:
             stats.update(self.controller.scalars())
         if self.resilience is not None:
@@ -1306,6 +1328,16 @@ class FederatedSession:
                 "collectives": self.cfg.overlap_collectives,
                 "double_buffer": bool(self.cfg.async_double_buffer),
             }
+        # host-axis topology (schema v12): present exactly when the mesh
+        # declares a hosts axis, so every collective figure in the report
+        # states which topology its all-reduces spanned
+        multihost_info = None
+        if self.cfg.num_hosts > 1:
+            multihost_info = {
+                "num_hosts": int(self.cfg.num_hosts),
+                "num_processes": int(jax.process_count()),
+                "host_id": int(jax.process_index()),
+            }
         return dict(
             mode=self.cfg.mode,
             sketch_decode=self.sketch_decode_resolved if is_sketch else None,
@@ -1320,6 +1352,7 @@ class FederatedSession:
                 up, sharded=sharded, workers=W, k=k_active
             ),
             overlap_info=overlap_info,
+            multihost_info=multihost_info,
         )
 
     # -- asyncfed programs -------------------------------------------------

@@ -19,6 +19,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+HOSTS = "hosts"
 WORKERS = "workers"
 MODEL = "model"
 SEQ = "seq"
@@ -98,24 +99,64 @@ def make_mesh(
     model: int = 1,
     seq: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    hosts: int = 1,
 ) -> Mesh:
-    """A (workers, model, seq) mesh over the available devices.
+    """A (workers, model, seq) mesh — or (hosts, workers, model, seq) when
+    ``hosts > 1`` — over the available devices.
 
     ``num_workers_axis * model * seq`` must equal the device count used.
     With one device this still yields a valid 1x1x1 mesh, so every code path
     is mesh-shaped even single-chip (jit specializes the collectives away).
+
+    ``hosts > 1`` splits the worker population's leading factor onto a
+    declared host axis: the flat worker index ``w`` of the 3-axis mesh maps
+    to ``(host=w // per_host, workers=w % per_host)`` on the 4-axis one, and
+    because the device order is unchanged, ``P((HOSTS, WORKERS))`` places
+    byte-identical shards to the 3-axis ``P(WORKERS)`` — the property the
+    multi-host twin tests pin. ``jax.devices()`` is already process-major,
+    so on a real pod the host axis coincides with process boundaries.
     """
     devices = list(devices if devices is not None else jax.devices())
     need = num_workers_axis * model * seq
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    arr = np.asarray(devices[:need]).reshape(num_workers_axis, model, seq)
-    return Mesh(arr, (WORKERS, MODEL, SEQ))
+    arr = np.asarray(devices[:need])
+    if hosts <= 1:
+        return Mesh(arr.reshape(num_workers_axis, model, seq),
+                    (WORKERS, MODEL, SEQ))
+    if num_workers_axis % hosts:
+        raise ValueError(
+            f"hosts={hosts} must divide the worker axis ({num_workers_axis})"
+        )
+    return Mesh(
+        arr.reshape(hosts, num_workers_axis // hosts, model, seq),
+        (HOSTS, WORKERS, MODEL, SEQ),
+    )
+
+
+def worker_axes(mesh: Mesh):
+    """The mesh axes a [W, ...] batch shards over: plain ``WORKERS`` on the
+    3-axis mesh, the ``(HOSTS, WORKERS)`` tuple on a multi-host mesh. The
+    tuple is what collectives take as ``axis_name`` so psums span both
+    levels in one reduction."""
+    return (HOSTS, WORKERS) if HOSTS in mesh.axis_names else WORKERS
+
+
+def worker_axis_size(mesh: Mesh) -> int:
+    """Total worker-slot count of the mesh (product over worker axes)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = worker_axes(mesh)
+    if isinstance(axes, str):
+        return sizes[axes]
+    out = 1
+    for a in axes:
+        out *= sizes[a]
+    return out
 
 
 def worker_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis sharding over the workers axis (for [W, ...] batches)."""
-    return NamedSharding(mesh, P(WORKERS))
+    """Leading-axis sharding over the worker axes (for [W, ...] batches)."""
+    return NamedSharding(mesh, P(worker_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

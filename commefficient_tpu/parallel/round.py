@@ -78,7 +78,11 @@ from commefficient_tpu.ops.collectives import (
 )
 from commefficient_tpu.ops.countsketch import CountSketch
 from commefficient_tpu.ops.param_utils import clip_by_global_norm
-from commefficient_tpu.parallel.mesh import WORKERS
+from commefficient_tpu.parallel.mesh import (
+    WORKERS,
+    worker_axes,
+    worker_axis_size,
+)
 from commefficient_tpu.telemetry import (
     round_diagnostics,
     round_diagnostics_sparse,
@@ -186,6 +190,7 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
     raw gradient is then explicitly psummed over those axes (see
     utils.jax_compat.grad_extra_axes_psum; no-op on current JAX)."""
     f32 = jnp.float32
+    data_axes = worker_axes(mesh) if mesh is not None else WORKERS
 
     def grad_one(params_vec, batch, noise_rng):
         params = unravel(params_vec)
@@ -197,7 +202,7 @@ def make_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable, mesh=None):
         with jax.named_scope("flat_grad_concat"):
             g, _ = ravel_pytree(grads)
         g = g.astype(f32)
-        g = grad_extra_axes_psum(g, mesh, WORKERS)
+        g = grad_extra_axes_psum(g, mesh, data_axes)
         if cfg.weight_decay:
             g = g + cfg.weight_decay * params_vec
         g = clip_by_global_norm(g, cfg.max_grad_norm)
@@ -289,6 +294,7 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
     groups = (
         leaf_groups(sizes, overlap_segments) if overlap_segments else None
     )
+    data_axes = worker_axes(mesh) if mesh is not None else WORKERS
 
     def grad_one_table(params_vec, batch, noise_rng):
         del noise_rng  # DP noise is a [D]-vector draw — gated off this path
@@ -307,7 +313,7 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
         # TP/SP meshes on pre-vma JAX: the explicit total over the extra
         # axes commutes with the (linear) sketch, so totaling the TABLE
         # is totaling the gradient (no-op on vma JAX / workers-only mesh)
-        table = grad_extra_axes_psum(table, mesh, WORKERS)
+        table = grad_extra_axes_psum(table, mesh, data_axes)
         if cfg.weight_decay:
             # sketch(g + wd*p) = sketch(g) + wd * sketch(p); the [D]
             # params vector already exists as state, so its sketch takes
@@ -342,7 +348,7 @@ def make_sketch_grad_one(cfg: Config, loss_fn: Callable, unravel: Callable,
         )
         (loss, aux), tables = jax.value_and_grad(tapped, has_aux=True)(zeros)
         tables = tuple(
-            grad_extra_axes_psum(t, mesh, WORKERS) for t in tables
+            grad_extra_axes_psum(t, mesh, data_axes) for t in tables
         )
         if cfg.weight_decay:
             # wd rides the FIRST group's table (the one whose cotangent
@@ -477,12 +483,18 @@ def resolve_aggregation(cfg: Config, comp, Wd: int) -> AggregationPlan:
 
 
 def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
-                        W: int, Wd: int, d: int):
+                        W: int, Wd: int, d: int, axes=WORKERS):
     """The cross-worker aggregation tail, called INSIDE a shard_map body
     over the workers axis: ``(local encoded transmit sum, loss_local, aux
     tree, w_loc) -> (agg, loss_mean, aux_sum)``. Extracted verbatim from
     ``worker_shard`` so the synchronous round and the asyncfed apply
     program share one collective layout per plan.
+
+    ``axes``: the collective axis group — the plain ``WORKERS`` string on
+    a single-host mesh, the ``(HOSTS, WORKERS)`` tuple on a multi-host
+    one, where every reduction here then spans both levels in one
+    collective (a psum over the tuple is bitwise-equal to the flat-axis
+    psum over the same devices; the multihost twin tests pin it).
 
     Layerwise overlap (``cfg.overlap_collectives``): a TUPLE ``local``
     is the sketch-fused backward's per-leaf-group tables — each group
@@ -503,12 +515,12 @@ def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
             # sketch-fused layerwise: one psum per leaf-group table,
             # issued inside the shard body as the backward produces them
             with jax.named_scope("overlap_layerwise_psum"):
-                summed_t = psum_segments(local, WORKERS)
+                summed_t = psum_segments(local, axes)
             agg = summed_t[0].astype(jnp.float32)
             for t in summed_t[1:]:
                 agg = agg + t.astype(jnp.float32)
             agg = agg / W
-            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+            summed = _psum_fused([loss_local] + aux_leaves, axes)
         elif plan.sparse_state:
             # true_topk sparse aggregation: reduce-scatter the dense
             # transmit sum — each chip keeps only its balanced [S] slice
@@ -517,12 +529,12 @@ def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
             dp = Wd * -(-d // Wd)
             agg = (
                 jax.lax.psum_scatter(
-                    jnp.pad(local, (0, dp - d)), WORKERS,
+                    jnp.pad(local, (0, dp - d)), axes,
                     scatter_dimension=0, tiled=True,
                 )
                 / W
             )
-            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+            summed = _psum_fused([loss_local] + aux_leaves, axes)
         elif plan.sparse_gather:
             # local_topk sparse aggregation: the device's summed transmit
             # has <= w_loc*k nonzeros (each client sends <= k), so one
@@ -531,14 +543,14 @@ def make_aggregate_tail(cfg: Config, comp, plan: AggregationPlan, *,
             # order, and everything downstream is byte-for-byte the dense
             # server path
             with jax.named_scope("sparse_allreduce"):
-                agg = sparse_allreduce(local, w_loc * cfg.k, WORKERS,
+                agg = sparse_allreduce(local, w_loc * cfg.k, axes,
                                        segments=segs) / W
-            summed = _psum_fused([loss_local] + aux_leaves, WORKERS)
+            summed = _psum_fused([loss_local] + aux_leaves, axes)
         else:
             # dense path: ONE fused all-reduce carries agg+loss+aux (the
             # bf16 sketch table keeps its own psum — see _psum_fused)
             fused_sum = _psum_fused([local, loss_local] + aux_leaves,
-                                    WORKERS)
+                                    axes)
             agg = fused_sum[0] / W
             summed = fused_sum[1:]
         loss_mean = summed[0] / W
@@ -562,21 +574,22 @@ def make_decode_mapped(cfg: Config, comp, mesh, plan: AggregationPlan, *,
     if not plan.sparse_apply:
         return None
     _, e_kind = comp.server_state_kinds()
+    axes = worker_axes(mesh)
 
     def decode_shard(momentum, error, comp_state, agg, lr, step):
         if plan.sparse_state:
             return comp.server_update_sparse(
                 momentum, error, comp_state, agg, lr, step,
-                axis_name=WORKERS, Wd=Wd, d=d,
+                axis_name=axes, Wd=Wd, d=d,
             )
         return comp.server_update_sharded(
             momentum, error, comp_state, agg, lr, step,
-            axis_name=WORKERS, Wd=Wd, d=d,
+            axis_name=axes, Wd=Wd, d=d,
         )
 
-    st_spec = P(WORKERS) if plan.sparse_state else P()
+    st_spec = P(axes) if plan.sparse_state else P()
     e_spec = (
-        P(WORKERS) if plan.sparse_state and e_kind == KIND_DENSE else P()
+        P(axes) if plan.sparse_state and e_kind == KIND_DENSE else P()
     )
     return shard_map(
         decode_shard,
@@ -826,12 +839,19 @@ def build_round_fn(
     # vector exchange is the <= W*k candidate pair all_gather. The sketch
     # EF re-sketch ride lives inside the compressor (compress/sketch.py
     # _ride_pair_exchange); its table psum is already O(r*c), not O(D).
-    Wd = dict(zip(mesh.axis_names, mesh.devices.shape))[WORKERS]
+    # worker-axes resolution (multihost/): on a 4-axis (hosts, workers,
+    # model, seq) mesh the batch shards and every worker collective runs
+    # over the (HOSTS, WORKERS) tuple — Wd is the TOTAL worker-slot count
+    # across hosts, so sparse-state slice geometry is unchanged vs the
+    # flat mesh of the same size
+    axes = worker_axes(mesh)
+    Wd = worker_axis_size(mesh)
     plan = resolve_aggregation(cfg, comp, Wd)
     sparse_state = plan.sparse_state
 
     per_client = make_per_client(cfg, comp, grad_one, use_fedsim=use_fedsim)
-    aggregate_tail = make_aggregate_tail(cfg, comp, plan, W=W, Wd=Wd, d=d)
+    aggregate_tail = make_aggregate_tail(cfg, comp, plan, W=W, Wd=Wd, d=d,
+                                         axes=axes)
 
     # ---- the shard body: this IS the worker process ----------------------
     def worker_shard(params_vec, batch, client_ids, vel_rows, err_rows, rng,
@@ -846,7 +866,7 @@ def build_round_fn(
         # varying keeps AD shard-local, so per-client momentum/error/
         # compression below see each client's own gradient; aggregation then
         # happens exactly once, at the explicit psum.
-        params_vec = pcast(params_vec, WORKERS, to="varying")
+        params_vec = pcast(params_vec, axes, to="varying")
 
         w_loc = client_ids.shape[0]
         if fused and sketch_fused:
@@ -900,7 +920,7 @@ def build_round_fn(
                                                  w_loc)
         return agg, loss_mean, aux_sum, new_vel, new_err
 
-    shard_spec = P(WORKERS)
+    shard_spec = P(axes)
     in_specs = (P(), shard_spec, shard_spec, shard_spec, shard_spec, P(), P())
     if use_fedsim:
         in_specs = in_specs + (shard_spec, shard_spec)  # live mask, corrupt

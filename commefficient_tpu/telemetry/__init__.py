@@ -180,7 +180,22 @@ from commefficient_tpu.telemetry.xla_audit import (
 # disjoint and <= wall_ms, anomaly flags), written at train-loop close
 # when cfg.run_report and by scripts/analyze_run.py; the header/flight
 # artifacts block advertises it under the same gate.
-SCHEMA_VERSION = 11
+# v12 (multihost PR): the multihost/* scalar namespace, emitted at level
+# >= 1 exactly when the run declares a host axis (cfg.num_hosts > 1 —
+# fixed for a run, so the key set stays constant): multihost/
+# num_processes an integer >= 1 (jax.process_count(): 1 on the
+# mesh-faked twin, the pod's process count on a real cluster);
+# multihost/host_id an integer in [0, num_processes); multihost/
+# cross_host_bytes >= 0 (the round's upload payload — every aggregation
+# collective rides the declared host axis, so the whole payload crosses
+# the host boundary once); multihost/dcn_exposed_ms >= 0 (un-hidden
+# collective wait attributed to DCN; 0.0 below spans attachment, the
+# xla/exposed_collective_ms discipline) — all checker-enforced.
+# perf_report.json gains a "multihost" block {num_hosts >= 2,
+# num_processes >= 1, host_id in [0, num_processes)} REQUIRED exactly
+# when the audited mesh declares a host axis and forbidden on
+# single-host reports, so wall-clock rows always state their topology.
+SCHEMA_VERSION = 12
 
 TELEMETRY_LEVELS = (0, 1, 2)
 

@@ -299,6 +299,7 @@ class CompiledRoundAudit:
                  tolerance_bytes: Optional[int] = None,
                  async_info: Optional[dict] = None,
                  overlap_info: Optional[dict] = None,
+                 multihost_info: Optional[dict] = None,
                  hlo_unavailable_reason: Optional[str] = None):
         self.cost = cost
         self.memory = memory
@@ -315,6 +316,13 @@ class CompiledRoundAudit:
         # wall-clock figure downstream can never be misattributed to the
         # wrong overlap setting
         self.overlap_info = dict(overlap_info) if overlap_info else None
+        # host-axis topology {num_hosts, num_processes, host_id} — present
+        # exactly when the audited round's mesh declares a hosts axis
+        # (schema v12 forbids the block on single-host reports), so a
+        # collective figure downstream always states which topology its
+        # all-reduces spanned. On the mesh-faked twin num_processes is 1;
+        # a real pod reports its jax.distributed process topology.
+        self.multihost_info = dict(multihost_info) if multihost_info else None
         # resolved --aggregate path (None when the compressor has no sparse
         # aggregation capability): 'sparse' arms the checker's no-O(D)
         # all-reduce/all-gather enforcement against sparse_agg_bound
@@ -430,6 +438,8 @@ class CompiledRoundAudit:
             rec["async"] = dict(self.async_info)
         if self.overlap_info is not None:
             rec["overlap"] = dict(self.overlap_info)
+        if self.multihost_info is not None:
+            rec["multihost"] = dict(self.multihost_info)
         if extra:
             rec.update(extra)
         return jsonable_tree(rec)

@@ -224,10 +224,15 @@ def train_loop(cfg: Config, session: FederatedSession, sampler: FedSampler,
 
 
 def main(argv=None, **overrides):
+    from commefficient_tpu.multihost import initialize_multihost
     from commefficient_tpu.parallel.mesh import initialize_distributed
 
-    initialize_distributed()  # no-op single-host
     cfg = parse_args(argv, **overrides)
+    # --distributed: the checked multihost bring-up (names a missing
+    # coordinator or a process-count/num_hosts mismatch); otherwise the
+    # legacy env-driven path (no-op single-host)
+    if not initialize_multihost(cfg):
+        initialize_distributed()
     train, test, real, model, params, loss_fn, augment = build_model_and_data(cfg)
     print(
         f"dataset={cfg.dataset_name} (real={real}) model={cfg.model} "

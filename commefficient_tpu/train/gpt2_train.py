@@ -270,9 +270,9 @@ def evaluate_ppl(session: FederatedSession, test_ds, batch_size: int):
 
 
 def main(argv=None, **overrides):
+    from commefficient_tpu.multihost import initialize_multihost
     from commefficient_tpu.parallel.mesh import initialize_distributed
 
-    initialize_distributed()  # no-op single-host
     cfg = parse_args(
         argv,
         defaults=dict(
@@ -284,6 +284,11 @@ def main(argv=None, **overrides):
         ),
         **overrides,
     )
+    # --distributed: the checked multihost bring-up (names a missing
+    # coordinator or a process-count/num_hosts mismatch); otherwise the
+    # legacy env-driven path (no-op single-host)
+    if not initialize_multihost(cfg):
+        initialize_distributed()
     train, test, real, hf_loaded, gcfg, model, params, loss_fn = (
         build_model_and_data(cfg)
     )

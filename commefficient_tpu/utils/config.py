@@ -341,6 +341,22 @@ class Config:
     # gpt2_train; cv_train is data-parallel only (as is the reference).
     model_axis: int = 1
     seq_axis: int = 1
+    # --- multi-host topology (commefficient_tpu/multihost/) ---
+    # Declared host axis size: > 1 prepends a `hosts` axis to the mesh
+    # ((hosts, workers, model, seq); parallel/mesh.py make_mesh), splits
+    # the client population into per-host partitions (multihost/
+    # topology.py), and routes every worker-axis collective over the
+    # (hosts, workers) tuple. 1 (default) = the single-host 3-axis mesh,
+    # byte-identical to a pre-multihost build. Works both with real
+    # multi-process runs (--distributed) and mesh-faked on one process
+    # (N virtual hosts over the local devices — the CI twin).
+    num_hosts: int = 1
+    # Call the jax.distributed bring-up at train entry (multihost/
+    # bringup.py initialize_multihost): reads JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES / JAX_PROCESS_ID and connects this process to
+    # the pod before any device query. False (default): single-process —
+    # mesh-faked multihost (num_hosts > 1) still works without it.
+    distributed: bool = False
 
     # --- telemetry (commefficient_tpu/telemetry/; TPU-native, no reference
     # analog — the reference logs only train/loss + lr) ---
@@ -825,6 +841,7 @@ class Config:
                 f"{self.pipeline_depth}"
             )
         self._validate_asyncfed()
+        self._validate_multihost()
         self._validate_control()
         self._validate_resilience()
 
@@ -1078,6 +1095,57 @@ class Config:
                 "preemption: in-flight cohorts would be abandoned "
                 "mid-arrival — disable preempt_signals / the preempt@ "
                 "chaos event"
+            )
+
+    def _validate_multihost(self) -> None:
+        """Multi-host topology flags (multihost/). num_hosts > 1 reroutes
+        every worker-axis collective over the (hosts, workers) tuple, so
+        the two round builders that still hardcode the plain workers axis
+        (fsdp, the tensor-parallel loss) are refused here at construction
+        instead of producing a wrong-axis program at first trace."""
+        if self.num_hosts < 1:
+            raise ValueError(
+                f"num_hosts must be >= 1, got {self.num_hosts}"
+            )
+        if self.distributed and self.num_hosts < 2:
+            raise ValueError(
+                "distributed=True runs the jax.distributed bring-up to "
+                "declare a host axis, which needs --num_hosts >= 2 (a "
+                "single-host run has nothing to connect; mesh-faked "
+                "multihost tests set num_hosts > 1 WITHOUT --distributed)"
+            )
+        if self.num_hosts == 1:
+            return
+        if self.num_hosts & (self.num_hosts - 1):
+            raise ValueError(
+                f"num_hosts must be a power of two, got {self.num_hosts}: "
+                "the two-level butterfly aggregation schedules cross-host "
+                "hops over a hypercube of hosts (ops/collectives/"
+                "sparse_allreduce.py), which only exists at 2^n"
+            )
+        if self.num_devices % self.num_hosts != 0:
+            raise ValueError(
+                "num_devices must be divisible by num_hosts "
+                f"({self.num_devices} % {self.num_hosts} != 0): the mesh "
+                "is (hosts, workers, model, seq) with workers = "
+                "num_devices / num_hosts chips per host"
+            )
+        if self.fsdp:
+            raise ValueError(
+                "num_hosts > 1 is incompatible with fsdp: the FSDP round "
+                "builder (parallel/fsdp.py) names the plain workers axis "
+                "in every shard spec and collective, so a declared host "
+                "axis would silently exclude cross-host devices from its "
+                "reduce-scatters — run the replicated round (the multihost "
+                "path) or fsdp, not both"
+            )
+        if self.model_axis > 1 or self.seq_axis > 1:
+            raise ValueError(
+                "num_hosts > 1 is incompatible with model_axis/seq_axis "
+                "> 1: the tensor-parallel loss (parallel/tensor.py) "
+                "shards batch rows with the plain workers axis spec, "
+                "which on a (hosts, workers, ...) mesh would replicate "
+                "the batch across hosts instead of sharding it"
             )
 
     def _validate_resilience(self) -> None:

@@ -91,10 +91,13 @@ def grad_extra_axes_psum(g, mesh, primary_axis):
     """
     if HAS_VMA or mesh is None:
         return g
+    primary = (
+        {primary_axis} if isinstance(primary_axis, str) else set(primary_axis)
+    )
     extra = tuple(
         a
         for a, n in zip(mesh.axis_names, mesh.devices.shape)
-        if a != primary_axis and n > 1
+        if a not in primary and n > 1
     )
     return jax.lax.pmean(g, extra) if extra else g
 

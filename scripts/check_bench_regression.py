@@ -102,6 +102,12 @@ TOLERANCES = {
     # deliberately (no entry would mean the same; this comment is the
     # registration the bench leg's docstring points at).
     "local_topk_hostclient_vs_device": DEFAULT_TOLERANCE,
+    # multihost PR: the mesh-faked 2-host round vs its single-host twin
+    # on the same devices — a same-run ratio of two same-shape programs
+    # (load cancels), so it gets the tight band and gates UP: declaring
+    # the host axis must not cost throughput (the tuple-axis psum lowers
+    # to ONE all-reduce; tests/test_multihost.py pins the HLO)
+    "sketch_multihost_vs_singlehost": 0.10,
 }
 
 # pipeline PR: the sketch_pipelined leg's samples/s + occupancy are gated
@@ -140,7 +146,10 @@ HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
                              # (*_cache_hit_rate and *_h2d_stage_ms stay
                              # informational — near-zero ms again, and the
                              # hit rate is config, not performance)
-                             "_vs_device")
+                             "_vs_device",
+                             # multihost PR: the mesh-faked 2-host round
+                             # must not lose to its flat single-host twin
+                             "_vs_singlehost")
 # resilience/control PRs: every *_retraces leg gauge is a hard invariant,
 # not a throughput — the AOT-prewarm contract says rung switches and
 # rollback restores never retrace, so ANY non-zero value fails outright

@@ -100,14 +100,20 @@ from pathlib import Path
 # beside a trace_id, non-empty, != trace_id — enforced below), and the
 # run_report.json artifact (validate_run_report: attribution fractions
 # in [0, 1] summing to ~1, per-round disjoint exclusive stage times
-# summing to the round's wall-clock). Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)
+# summing to the round's wall-clock); v12 (multihost PR): multihost/*
+# scalar namespace (num_processes an integer >= 1, host_id an integer
+# >= 0, cross_host_bytes / dcn_exposed_ms >= 0 — enforced below) and
+# perf_report's "multihost" block {num_hosts >= 2, num_processes >= 1,
+# host_id in [0, num_processes)} — REQUIRED when the report's config
+# declares a host axis (num_hosts > 1), FORBIDDEN on single-host
+# reports (enforced below). Older artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
                    "control/", "pipeline/", "resilience/", "async/",
-                   "clientstore/", "trace/")
+                   "clientstore/", "trace/", "multihost/")
 
 # pinned copy of telemetry.trace.STAGES (this checker imports nothing
 # from the package by design — tests/test_telemetry_schema.py pins the
@@ -357,6 +363,42 @@ def _check_clientstore_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_multihost_scalar(name: str, v, where: str) -> None:
+    """v12 ``multihost/*`` value invariants. Host-computed topology/
+    traffic gauges (parallel/api.py under cfg.num_hosts > 1), never
+    legitimately non-finite: ``num_processes`` is jax.process_count()
+    (>= 1 — exactly 1 on the mesh-faked twin); ``host_id`` is
+    jax.process_index() (a non-negative integer; the metrics stream is
+    per-process so the < num_processes half of the invariant is enforced
+    on the perf report's multihost block, where both live together);
+    ``cross_host_bytes`` is the round's upload payload riding the host
+    axis; ``dcn_exposed_ms`` an interval measure like
+    xla/exposed_collective_ms."""
+    if not name.startswith("multihost/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "multihost/num_processes" and (v != int(v) or v < 1):
+        raise SchemaError(
+            f"{where}: multihost/num_processes {v} is not a positive "
+            "integer — it counts whole pod processes (1 = mesh-faked)"
+        )
+    if name == "multihost/host_id" and (v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: multihost/host_id {v} is not a non-negative "
+            "integer — it is this process's index in the pod"
+        )
+    if name in ("multihost/cross_host_bytes",
+                "multihost/dcn_exposed_ms") and v < 0:
+        raise SchemaError(
+            f"{where}: {name} {v} is negative — byte counts and "
+            "wall-clock exposure gauges are >= 0"
+        )
+
+
 def _check_xla_scalar(name: str, v, where: str) -> None:
     """v9 ``xla/exposed_collective_ms`` value invariant: a host-computed
     cumulative gauge (interval arithmetic over the span recorder — never
@@ -473,6 +515,7 @@ def validate_metrics_jsonl(path) -> int:
             _check_resilience_scalar(name, rec["value"], where)
             _check_async_scalar(name, rec["value"], where)
             _check_clientstore_scalar(name, rec["value"], where)
+            _check_multihost_scalar(name, rec["value"], where)
             _check_xla_scalar(name, rec["value"], where)
             _check_trace_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
@@ -661,6 +704,7 @@ def validate_flight(path) -> dict:
             _check_resilience_scalar(name, v, w)
             _check_async_scalar(name, v, w)
             _check_clientstore_scalar(name, v, w)
+            _check_multihost_scalar(name, v, w)
             _check_xla_scalar(name, v, w)
             _check_trace_scalar(name, v, w)
         if last is not None and step <= last:
@@ -771,6 +815,46 @@ def validate_perf_report(path) -> dict:
             f"{cfg_blk.get('overlap_collectives', 'none')!r}, "
             f"async_double_buffer={cfg_blk.get('async_double_buffer')!r}) "
             "but the report carries no 'overlap' block (schema v9)"
+        )
+    # v12: the multihost block is required exactly when the report's
+    # config declares a host axis — a pod report without one would leave
+    # its wall-clock rows unattributable to a topology, and a single-host
+    # report carrying one means the producer mislabeled the mesh
+    cfg_multihost = int(cfg_blk.get("num_hosts", 1) or 1) > 1
+    if "multihost" in rec:
+        blk = _req(rec, "multihost", dict, where)
+        nh = blk.get("num_hosts")
+        if isinstance(nh, bool) or not isinstance(nh, int) or nh < 2:
+            raise SchemaError(
+                f"{where}:multihost: num_hosts must be an integer >= 2 "
+                f"(the block only rides multi-host audits), got {nh!r}"
+            )
+        nproc = blk.get("num_processes")
+        if isinstance(nproc, bool) or not isinstance(nproc, int) or nproc < 1:
+            raise SchemaError(
+                f"{where}:multihost: num_processes must be an integer "
+                f">= 1 (1 = mesh-faked twin), got {nproc!r}"
+            )
+        hid = blk.get("host_id")
+        if (isinstance(hid, bool) or not isinstance(hid, int)
+                or not 0 <= hid < nproc):
+            raise SchemaError(
+                f"{where}:multihost: host_id {hid!r} outside "
+                f"[0, num_processes={nproc}) — the writing process's "
+                "index in the pod"
+            )
+        if cfg_blk and not cfg_multihost:
+            raise SchemaError(
+                f"{where}: 'multihost' block present but the report's "
+                "config declares no host axis (num_hosts="
+                f"{cfg_blk.get('num_hosts', 1)!r}) — mislabeled producer "
+                "(schema v12)"
+            )
+    elif cfg_multihost:
+        raise SchemaError(
+            f"{where}: config declares a host axis (num_hosts="
+            f"{cfg_blk.get('num_hosts')!r}) but the report carries no "
+            "'multihost' block (schema v12)"
         )
     _check_header({**_req(rec, "meta", dict, where),
                    "schema_version": rec["schema_version"]}, where + ":meta")

@@ -1,12 +1,16 @@
 """Child process for tests/test_multihost.py — NOT a test module.
 
 Runs as ``python multihost_child.py <pid> <port>``: joins a 2-process
-jax.distributed cluster (4 virtual CPU devices each) through the
-PUBLIC bring-up path (``parallel.mesh.initialize_distributed`` reading
-JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID), then runs one
-federated sketch round over the 8-device global mesh — the multi-host
-capability SURVEY.md §5 names as the rebuild extension (psum across
-processes stands in for DCN).
+jax.distributed cluster (4 virtual CPU devices each) through the PUBLIC
+multihost bring-up (``multihost.initialize_multihost`` reading
+JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID, then
+``make_global_mesh`` declaring the (hosts, workers, model, seq) pod
+mesh), builds THIS host's topology + data plane, and runs federated
+sketch rounds whose table psum crosses the process boundary (Gloo
+standing in for DCN). Each process realizes only its own client
+partition's batch rows (``HostDataPlane`` + ``assemble_rows``); the
+cohort id vector is global (draws are cheap ints, every process computes
+every host's).
 """
 
 import os
@@ -24,24 +28,42 @@ from commefficient_tpu.utils.platform import force_virtual_cpu_devices  # noqa: 
 
 force_virtual_cpu_devices(4)
 
-from commefficient_tpu.parallel.mesh import (  # noqa: E402
-    initialize_distributed,
-    make_mesh,
+import numpy as np  # noqa: E402
+
+from commefficient_tpu.utils.config import Config  # noqa: E402
+
+cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
+             k=8, num_rows=3, num_cols=64, num_clients=16, num_workers=8,
+             num_devices=8, local_batch_size=4, weight_decay=0.0,
+             num_hosts=2, distributed=True)
+
+from commefficient_tpu.multihost import (  # noqa: E402
+    HostDataPlane,
+    assemble_rows,
+    build_topology,
+    global_client_ids,
+    initialize_multihost,
+    make_global_mesh,
+    validate_mesh_topology,
 )
 
-assert initialize_distributed() is True
+assert initialize_multihost(cfg) is True
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 import flax.linen as nn  # noqa: E402
 
 assert len(jax.devices()) == 8, jax.devices()
 assert len(jax.local_devices()) == 4
 
+mesh = make_global_mesh(cfg)
+topology = build_topology(cfg)  # host_id = jax.process_index()
+assert topology.host_id == pid
+validate_mesh_topology(mesh, topology)
+
+from commefficient_tpu.data import FedDataset  # noqa: E402
 from commefficient_tpu.models import classification_loss  # noqa: E402
 from commefficient_tpu.parallel import FederatedSession  # noqa: E402
-from commefficient_tpu.utils.config import Config  # noqa: E402
 
 
 class MLP(nn.Module):
@@ -53,16 +75,36 @@ class MLP(nn.Module):
 model = MLP()
 params = model.init(jax.random.key(0), jnp.zeros((1, 6)))
 loss_fn = classification_loss(model.apply)
-cfg = Config(mode="sketch", error_type="virtual", virtual_momentum=0.9,
-             k=8, num_rows=3, num_cols=64, num_clients=16, num_workers=8,
-             num_devices=8, local_batch_size=4, weight_decay=0.0)
-session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(8))
-rng = np.random.default_rng(0)  # same seed both processes -> same batch
-ids = rng.choice(16, size=8, replace=False).astype(np.int32)
-batch = {"x": rng.normal(size=(8, 4, 6)).astype(np.float32),
-         "y": rng.integers(0, 4, size=(8, 4)).astype(np.int32)}
+session = FederatedSession(cfg, params, loss_fn, mesh=mesh)
+
+rng = np.random.default_rng(0)  # same seed both processes -> same dataset
+x = rng.normal(size=(320, 6)).astype(np.float32)
+y = rng.integers(0, 4, size=320).astype(np.int32)
+ds = FedDataset({"x": x, "y": y}, cfg.num_clients, iid=True, seed=0)
+
+# every process holds a plane PER HOST for the id draws (cheap ints); only
+# its OWN plane realizes batch rows
+planes = [
+    HostDataPlane(ds, build_topology(cfg, host_id=h),
+                  local_batch_size=cfg.local_batch_size, seed=cfg.seed)
+    for h in range(cfg.num_hosts)
+]
+mine = planes[topology.host_id]
+
 loss = None
 for r in range(2):
+    ids = global_client_ids(planes, r)  # host-major [W], same everywhere
+    local_ids, local_batch = mine.sample_round(r)
+    np.testing.assert_array_equal(
+        ids[topology.slot_range[0]:topology.slot_range[1]], local_ids)
+    # lift this host's rows into the global [W, B, ...] arrays — the
+    # callback only materializes shards this process addresses, so the
+    # other host's rows never exist here
+    batch = {
+        k: assemble_rows(mesh, {topology.host_id: v},
+                         num_hosts=cfg.num_hosts)
+        for k, v in local_batch.items()
+    }
     m = session.train_round(ids, batch, lr=0.1)
     loss = float(m["loss"])
     assert np.isfinite(loss), loss

@@ -389,3 +389,31 @@ def test_sketch_traced_rows_are_informational(tmp_path):
     # the detects-regression guard still bites with traced rows present
     regs, _, _ = mod.check_regression([good], {**moved, "value": 19000.0})
     assert [r["metric"] for r in regs] == ["value"]
+
+
+def test_multihost_metrics_registered_and_gated(tmp_path):
+    """ISSUE 19 satellite: the multihost bench leg gates on its
+    _vs_singlehost ratio (higher is better, tight 10% band — twin runs
+    of one geometry on the same devices, load cancels); the bare
+    samples/s row gates through the generic _samples_per_sec suffix,
+    and error/skip markers never gate."""
+    mod = _gate()
+    assert mod.metric_direction("sketch_multihost_vs_singlehost") == "up"
+    assert mod.tolerance_for("sketch_multihost_vs_singlehost", 0.15) == 0.10
+    assert mod.metric_direction("sketch_multihost_samples_per_sec") == "up"
+    assert mod.metric_direction("sketch_multihost_error") is None
+    assert mod.metric_direction("sketch_multihost_skipped") is None
+    # detects-regression self-test: the host axis growing a cost
+    # (1.0x -> 0.8x) past the band must gate and name the ratio
+    good = {**BASELINE, "sketch_multihost_vs_singlehost": 1.0}
+    bad = {**BASELINE, "sketch_multihost_vs_singlehost": 0.8}
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json", bad)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    regs, _, _ = mod.check_regression([good], bad)
+    assert [r["metric"] for r in regs] == ["sketch_multihost_vs_singlehost"]
+    assert regs[0]["direction"] == "up"
+    # within the band passes
+    regs, _, _ = mod.check_regression(
+        [good], {**BASELINE, "sketch_multihost_vs_singlehost": 0.95})
+    assert regs == []

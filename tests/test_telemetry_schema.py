@@ -1036,3 +1036,113 @@ def test_v11_run_report_validates_and_rejects(tmp_path):
     tampered(lambda r: r.update(rounds=r["rounds"][:1]),
              "per-round entries")
     tampered(lambda r: r.update(kind="bench"), "kind must be")
+
+
+# ---------------------------------------------------------------------------
+# v12: multihost/* scalars and the perf-report multihost block
+# ---------------------------------------------------------------------------
+
+def test_v12_multihost_scalars_validate_and_reject(tmp_path):
+    """The multihost/ topology prefix is in-schema through the REAL
+    writer (the end-to-end form — these scalars riding a num_hosts > 1
+    session's rounds — is pinned by tests/test_multihost.py); the
+    value invariants reject every tampering direction on both scalar
+    paths."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, num_workers=8,
+                 num_devices=8, num_hosts=2)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(3):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        # 1 process = the mesh-faked twin; bytes/exposure are gauges
+        writer.scalar("multihost/num_processes", 1.0, s)
+        writer.scalar("multihost/host_id", 0.0, s)
+        writer.scalar("multihost/cross_host_bytes", 4096.0 * s, s)
+        writer.scalar("multihost/dcn_exposed_ms", 0.5 * s, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 18
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "multihost/num_processes", "value": 0.0, "step": 0,
+          "t": 1.0}, "positive"),
+        ({"name": "multihost/num_processes", "value": 1.5, "step": 0,
+          "t": 1.0}, "positive"),
+        ({"name": "multihost/host_id", "value": -1.0, "step": 0,
+          "t": 1.0}, "non-negative"),
+        ({"name": "multihost/host_id", "value": 0.5, "step": 0,
+          "t": 1.0}, "non-negative"),
+        ({"name": "multihost/cross_host_bytes", "value": -4096.0,
+          "step": 0, "t": 1.0}, "negative"),
+        ({"name": "multihost/dcn_exposed_ms", "value": -0.5, "step": 0,
+          "t": 1.0}, "negative"),
+        ({"name": "multihost/num_processes", "value": "nan", "step": 0,
+          "t": 1.0}, "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+    # same invariants hold on the flight recorder's metric blocks
+    flight = FlightRecorder(cfg, logdir=str(tmp_path))
+    for s in range(3):
+        flight.record(s, 0.1, {"loss": 1.0, "multihost/num_processes": 1.0,
+                               "multihost/cross_host_bytes": 4096.0})
+    fpath = flight.dump(2, reason="test dump", first_bad_step=2)
+    mod.validate_flight(fpath)
+
+    def tampered(mutate, msg):
+        with open(fpath) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_flight.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_flight(bad)
+
+    tampered(lambda r: r["records"][0]["scalars"].update(
+        {"multihost/num_processes": 0.0}), "positive")
+    tampered(lambda r: r["records"][0]["scalars"].update(
+        {"multihost/cross_host_bytes": -1.0}), "negative")
+
+
+def test_v12_perf_report_multihost_block_required_and_forbidden(tmp_path):
+    """A REAL mesh-faked 2-host audit report carries the topology block
+    and validates; the checker rejects every mislabeling direction —
+    block removed from a multi-host report, single-host geometry inside
+    the block, host_id outside the pod, and the block riding a report
+    whose config declares no host axis."""
+    mod = _checker()
+    path = _write_perf_report(tmp_path, num_hosts=2)
+    rec = mod.validate_perf_report(path)
+    assert rec["multihost"] == {"num_hosts": 2, "num_processes": 1,
+                                "host_id": 0}
+
+    def tampered(mutate, msg):
+        with open(path) as f:
+            r = json.load(f)
+        mutate(r)
+        bad = os.path.join(str(tmp_path), "bad_report.json")
+        with open(bad, "w") as f:
+            json.dump(r, f)
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_perf_report(bad)
+
+    tampered(lambda r: r.pop("multihost"), "no 'multihost' block")
+    tampered(lambda r: r["multihost"].update(num_hosts=1),
+             "integer >= 2")
+    tampered(lambda r: r["multihost"].update(num_hosts=2.5),
+             "integer >= 2")
+    tampered(lambda r: r["multihost"].update(num_processes=0),
+             "integer >= 1")
+    tampered(lambda r: r["multihost"].update(host_id=1),
+             "outside")
+    tampered(lambda r: r["multihost"].update(host_id=-1),
+             "outside")
+    # forbidden direction: the block riding a single-host report
+    tampered(lambda r: r["meta"]["config"].update(num_hosts=1),
+             "mislabeled producer")
