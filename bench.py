@@ -1103,6 +1103,64 @@ def _measure_overlap(base, n_rounds: int = 10, n_updates: int = 8) -> dict:
     return out
 
 
+def _measure_elastic(base, n_rounds: int = 8) -> dict:
+    """Elastic-fleet PR (schema v13): the headline sketch round under a
+    scheduled width resize (8 -> 4 for three rounds, then back) through
+    the REAL width ladder — one shrink and one grow transition inside
+    the timed window. The design claim is the retrace gauge: every
+    realized width dispatches a prewarmed per-width program, so a resize
+    is a dispatch-table swap (``sketch_elastic_resize_ms`` totals the
+    swap cost — microseconds, not a re-trace) and
+    ``sketch_elastic_retraces`` must be EXACTLY 0 (gated by
+    scripts/check_bench_regression.py). Samples/s counts each round's
+    REALIZED width — the fleet does less work while shrunk, and the leg
+    reports the real rate, not the base-width fiction."""
+    import jax
+    import jax.numpy as jnp
+
+    from commefficient_tpu.models import ResNet9, classification_loss
+    from commefficient_tpu.models.losses import model_dtype
+    from commefficient_tpu.parallel import FederatedSession, make_mesh
+    from commefficient_tpu.utils.profiling import fence
+
+    cfg = base.replace(chaos="resize@4:rounds=3-5")
+    W, B = cfg.num_workers, cfg.local_batch_size
+    model = ResNet9(num_classes=10, dtype=model_dtype(cfg.compute_dtype))
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    loss_fn = classification_loss(model.apply,
+                                  compute_dtype=cfg.compute_dtype)
+    session = FederatedSession(cfg, params, loss_fn, mesh=make_mesh(1))
+    rng = np.random.default_rng(0)
+    ids = rng.choice(cfg.num_clients, size=W, replace=False).astype(np.int32)
+    batch = {
+        "x": rng.normal(size=(W, B, 32, 32, 3)).astype(np.float32),
+        "y": rng.integers(0, 10, size=(W, B)).astype(np.int32),
+    }
+    # AOT-lower every width's round program (the runner's prewarm path) —
+    # without it the first shrunk round would pay a fresh trace and the
+    # retrace gauge below would catch it
+    session.prewarm_rungs(ids, batch, 0.1)
+    env = session.fedsim_env
+    # warmup: rounds 0-2 run at the base width (the resize window opens
+    # at round 3) — compile + donated-layout warmup outside the window
+    for _ in range(3):
+        fence(session.train_round(ids, batch, 0.1)["loss"])
+    t0 = time.perf_counter()
+    samples = 0
+    for r in range(3, 3 + n_rounds):
+        m = session.train_round(ids, batch, 0.1)
+        samples += env.width_at(r) * B  # the round's REALIZED width
+    assert np.isfinite(fence(m["loss"]))
+    dt = time.perf_counter() - t0
+    resizes = sum(1 for rr, _w in env.transitions if rr < 3 + n_rounds)
+    return {
+        "sketch_elastic_samples_per_sec": round(samples / dt, 2),
+        "sketch_elastic_resizes": resizes,
+        "sketch_elastic_resize_ms": round(session._fleet_resize_ms, 3),
+        "sketch_elastic_retraces": session.retrace_sentinel.retraces,
+    }
+
+
 def _measure_multihost(base, n_rounds: int = 10) -> dict:
     """Multihost PR: the mesh-faked 2-host sketch round (4-axis
     ``(hosts, workers, model, seq)`` mesh, the table psum riding the
@@ -1352,6 +1410,18 @@ def main():
         else:
             rows.update(mh)
             print(json.dumps({"metric": "sketch_multihost", **mh}))
+        # elastic-fleet PR: the headline round across a scheduled width
+        # shrink + grow through the real width ladder — resize cost and
+        # the hard-zero retrace gauge (per-leg error isolation as above)
+        try:
+            el = _measure_elastic(base)
+        except Exception as e:  # noqa: BLE001
+            rows["sketch_elastic_error"] = f"{type(e).__name__}: {e}"[:200]
+            print(json.dumps({"metric": "sketch_elastic",
+                              "error": rows["sketch_elastic_error"]}))
+        else:
+            rows.update(el)
+            print(json.dumps({"metric": "sketch_elastic", **el}))
 
     # pipeline PR: the pipelined-execution leg rides the HEADLINE line
     # (gated by scripts/check_bench_regression.py — occupancy + samples/s

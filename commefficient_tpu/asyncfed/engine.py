@@ -86,14 +86,18 @@ class AsyncFederation:
         self.profiler = profiler
         self.W = int(cfg.num_workers)
         self._alpha = float(cfg.staleness_exponent)
-        self.schedule = AsyncSchedule(
-            seed=cfg.seed,
-            num_workers=self.W,
-            buffer_k=cfg.async_buffer,
-            concurrency=cfg.async_concurrency,
-            arrival_rate=cfg.arrival_rate,
-            num_updates=self.num_rounds,
-        )
+        # engine-local (K, C): the cfg's static values normally; under an
+        # ADAPTS_ASYNC control policy the controller owns the live pair
+        # (its state blob restores the retuned values before start(), so
+        # a checkpoint resume dispatches the retuned schedule, not the
+        # cfg one)
+        self._k = int(cfg.async_buffer)
+        self._c = int(cfg.async_concurrency)
+        ctl = session.controller
+        if ctl is not None and getattr(ctl.policy, "ADAPTS_ASYNC", False):
+            self._k = int(ctl.async_k)
+            self._c = int(ctl.async_c)
+        self.schedule = self._build_schedule()
         self._scheduler: Optional[CohortScheduler] = None
         # in-flight window: cohort -> launch record (device outputs + the
         # host live mask/stats/version the apply assembly reads)
@@ -120,8 +124,16 @@ class AsyncFederation:
         self._double_buffer = bool(getattr(cfg, "async_double_buffer",
                                            False))
         self._deferred = None
+        # staleness-aware (K, C) retune (schema v13): the controller's
+        # decision point runs mid-update, so a retune is PARKED here and
+        # applied at the top of the next update's loop iteration — a cold
+        # window rebuild under the new schedule
+        self._retune_pending = None
+        self.retunes_applied = 0
         if session.controller is not None:
             session.controller.add_switch_listener(self._on_rung_switch)
+            if getattr(session.controller.policy, "ADAPTS_ASYNC", False):
+                session.controller.add_retune_listener(self._on_retune)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, resume_step: int = 0) -> "AsyncFederation":
@@ -140,6 +152,17 @@ class AsyncFederation:
             self._scheduler = None
         blob, self._restored = self._restored, None
         self._pending, self._consumed = {}, {}
+        if blob is not None:
+            # the snapshot's (K, C) wins: the window it carries was
+            # captured under THAT schedule, and the controller's own blob
+            # (restored alongside) re-notified the same pair — so any
+            # parked retune is stale by construction
+            k = int(blob.get("k", self._k))
+            c = int(blob.get("c", self._c))
+            if (k, c) != (self._k, self._c):
+                self._k, self._c = k, c
+                self.schedule = self._build_schedule()
+            self._retune_pending = None
         self._init_window(int(step), blob)
         self.restarts += 1
         if self.spans is not None:
@@ -152,6 +175,19 @@ class AsyncFederation:
             self._scheduler.close()
             self._scheduler = None
 
+    def _build_schedule(self) -> AsyncSchedule:
+        """The pre-simulated arrival/consumption script for the CURRENT
+        engine-local (K, C) — rebuilt whole on retune (same seed, so the
+        arrival process is the one deterministic object it always was)."""
+        return AsyncSchedule(
+            seed=self.cfg.seed,
+            num_workers=self.W,
+            buffer_k=self._k,
+            concurrency=self._c,
+            arrival_rate=self.cfg.arrival_rate,
+            num_updates=self.num_rounds,
+        )
+
     def _build_scheduler(self, start_cohort: int) -> CohortScheduler:
         return CohortScheduler(
             session=self.session,
@@ -160,7 +196,7 @@ class AsyncFederation:
             launch_versions=self.schedule.launch_version,
             start_cohort=start_cohort,
             stop_cohort=self.schedule.num_cohorts,
-            depth=max(1, int(self.cfg.async_concurrency)),
+            depth=max(1, self._c),
             microbatches=self.cfg.round_microbatches,
             spans=self.spans,
             replay_until=self._cohort_horizon,
@@ -282,10 +318,43 @@ class AsyncFederation:
         self._cohorts_launched += 1
         self._cohort_horizon = max(self._cohort_horizon, c + 1)
 
+    # -- (K, C) retune (staleness_aware control, schema v13) ---------------
+    def _on_retune(self, step: int, k: int, c: int) -> None:
+        """Controller retune listener — also re-fired by a state-blob
+        load, so a no-op pair (checkpoint resume already built this
+        schedule) must not force a spurious window rebuild."""
+        if (int(k), int(c)) == (self._k, self._c):
+            return
+        self._retune_pending = (int(k), int(c))
+
+    def _apply_retune(self, step: int) -> None:
+        """Rebuild the schedule + in-flight window under the retuned
+        (K, C) — a cold window restart like ``restart`` without a vault
+        blob: the new schedule's pending cohorts relaunch against the
+        CURRENT params, deterministic going forward (the same FedBuff
+        trade the plain checkpoint resume makes)."""
+        (self._k, self._c), self._retune_pending = self._retune_pending, None
+        self._drain_deferred()
+        if self._scheduler is not None:
+            self._scheduler.close()
+            self._scheduler = None
+        self.schedule = self._build_schedule()
+        self._pending, self._consumed = {}, {}
+        self._init_window(int(step), None)
+        self.retunes_applied += 1
+        if self.spans is not None:
+            with self.spans.span(
+                    f"async_retune:round{step}:k{self._k}c{self._c}"):
+                pass
+
     # -- the update loop ---------------------------------------------------
     def epoch_rounds(self, epoch: int, start_step: int):
         spe = self.steps_per_epoch
         for step in range(max(epoch * spe, start_step), (epoch + 1) * spe):
+            # a retune parked by the PREVIOUS update's decision point
+            # lands here, before this update reads its schedule spec
+            if self._retune_pending is not None:
+                self._apply_retune(step)
             spec = self.schedule.updates[step]
             stall = 0.0
             for c in spec.launches_before:
@@ -467,6 +536,10 @@ class AsyncFederation:
             "update": int(self.session._round_clock),
             "next_cohort": int(self._next_cohort),
             "cohort_horizon": int(self._cohort_horizon),
+            # the (K, C) the window was captured under — restart() rebuilds
+            # the matching schedule before replaying it (retune rider)
+            "k": int(self._k),
+            "c": int(self._c),
             "consumed": {int(c): int(n)
                          for c, n in self._consumed.items()},
             "pending": pending,
@@ -484,4 +557,5 @@ class AsyncFederation:
             "host_stall_ms": self._host_stall_ms,
             "restarts": self.restarts,
             "quiesces": self.quiesces,
+            "retunes_applied": self.retunes_applied,
         }

@@ -44,13 +44,18 @@ from commefficient_tpu.control.policy import (
     get_policy,
 )
 
-_BLOB_VERSION = 2
+_BLOB_VERSION = 3
 # blob layout: [version, rung, switches, rounds_seen, spent_up, spent_down,
-#               last_switch_round, min_rung, *policy slots] — float64 is
-# exact for every field (byte counts stay far below 2^53). v2 adds the
-# resilience demotion floor ``min_rung`` at index 7; v1 blobs (one slot
-# shorter) still load with the floor defaulting to 0.
-_BLOB_FIXED = 8
+#               last_switch_round, min_rung, fleet_width, async_k, async_c,
+#               retunes, last_retune_round, *policy slots] — float64 is
+# exact for every field (byte counts stay far below 2^53). v2 added the
+# resilience demotion floor ``min_rung`` at index 7; v3 adds the fleet
+# width at capture at index 8 (-1 when the run schedules no fleet events;
+# ADVISORY — restore re-derives the width from the round schedule) and
+# the asyncfed retune state at 9-12. Older blobs still load, with the
+# missing fields defaulting (floor 0; config-initial K/C, zero retunes).
+_BLOB_FIXED = 13
+_BLOB_FIXED_V2 = 8
 _BLOB_FIXED_V1 = 7
 
 
@@ -95,6 +100,16 @@ class BudgetController:
         # program is AOT-prewarmed — the listener lets the engine account/
         # span the quiesce without re-deriving any of that.
         self._switch_listeners = []
+        # asyncfed (K, C) retune state (staleness_aware policy): the
+        # controller owns the authoritative pair — the engine registers a
+        # retune listener and rebuilds its arrival schedule when the pair
+        # moves. Present (at the config's initial values) for every
+        # policy; only ADAPTS_ASYNC policies ever move it.
+        self.async_k = int(cfg.async_buffer)
+        self.async_c = int(cfg.async_concurrency)
+        self.retunes = 0
+        self.last_retune_round = -1
+        self._retune_listeners = []
         session.controller = self
 
     def add_switch_listener(self, fn) -> None:
@@ -103,10 +118,19 @@ class BudgetController:
         observers — raising would abort the round the switch serves."""
         self._switch_listeners.append(fn)
 
+    def add_retune_listener(self, fn) -> None:
+        """Register ``fn(step, k, c)``, called when an ADAPTS_ASYNC
+        policy moves the asyncfed (buffer K, concurrency C) pair — the
+        engine's hook for rebuilding its pre-simulated arrival schedule.
+        Same observer discipline as the switch listeners."""
+        self._retune_listeners.append(fn)
+
     # -- byte accounting (mirrors telemetry.CommLedger exactly) ------------
     def _live_avail(self, fs_stats: Optional[Dict[str, float]]):
-        W = self.cfg.num_workers
         s = fs_stats or {}
+        # elastic-fleet rounds account at the round's REALIZED width (the
+        # fedsim/* rates are relative to it) — exactly CommLedger._counts
+        W = int(round(float(s.get("fleet/width", self.cfg.num_workers))))
         rate = s.get("fedsim/participation_rate")
         live = W if rate is None else int(round(float(rate) * W))
         avail = W - int(round(float(s.get("fedsim/dropped", 0.0))))
@@ -158,7 +182,8 @@ class BudgetController:
         # in fs_stats unconditionally) — None on synchronous rounds
         stale = s.get("async/staleness_mean")
         eff = s.get("async/effective_participation")
-        target = self.policy.decide(DecisionContext(
+        fill = s.get("async/buffer_fill")
+        ctx = DecisionContext(
             step=step, num_rounds=self.num_rounds, rung=rung,
             num_rungs=self.num_rungs,
             round_bytes=lambda r: self.round_bytes(r, live, avail),
@@ -167,7 +192,10 @@ class BudgetController:
             hysteresis=self.cfg.control_hysteresis,
             staleness_mean=None if stale is None else float(stale),
             effective_participation=None if eff is None else float(eff),
-        ))
+            buffer_fill=None if fill is None else float(fill),
+            num_workers=self.cfg.num_workers,
+        )
+        target = self.policy.decide(ctx)
         target = min(max(int(target), 0), self.num_rungs - 1)
         # resilience demotion floor: a divergence-demoted run never climbs
         # back above the floor, whatever the policy says (higher index ==
@@ -196,9 +224,32 @@ class BudgetController:
             self.last_switch_round = step
             for fn in self._switch_listeners:
                 fn(step, rung, target)
+        if self.policy.ADAPTS_ASYNC:
+            self._maybe_retune(step, ctx)
         self._spend(target, live, avail)
         self.rounds_seen += 1
         return target
+
+    def _maybe_retune(self, step: int, ctx: DecisionContext) -> None:
+        """Ask an ADAPTS_ASYNC policy for the next asyncfed (K, C) pair,
+        clamp it to the engine's legality window (1 <= K <= W, C >= 1),
+        and notify the retune listeners on a change. Hysteresis mirrors
+        the rung walk's: no retune within ``control_hysteresis`` rounds
+        of the last one, so the schedule rebuild cannot thrash."""
+        if (self.last_retune_round >= 0
+                and step - self.last_retune_round
+                < self.cfg.control_hysteresis):
+            return
+        k, c = self.policy.decide_async(ctx, self.async_k, self.async_c)
+        k = min(max(int(k), 1), int(self.cfg.num_workers))
+        c = max(int(c), 1)
+        if (k, c) == (self.async_k, self.async_c):
+            return
+        self.async_k, self.async_c = k, c
+        self.retunes += 1
+        self.last_retune_round = step
+        for fn in self._retune_listeners:
+            fn(step, k, c)
 
     def demote(self, step: int) -> int:
         """Resilience recovery action (resilience/policy.py DemotePolicy):
@@ -246,6 +297,12 @@ class BudgetController:
             out["control/budget_remaining_bytes"] = float(
                 self.budget_bytes - self.spent_bytes
             )
+        if self.policy.ADAPTS_ASYNC:
+            # (K, C) decision trail (schema v13) — capability-gated, so
+            # the key set stays constant for the run either way
+            out["control/async_k"] = float(self.async_k)
+            out["control/async_c"] = float(self.async_c)
+            out["control/retunes"] = float(self.retunes)
         return out
 
     def observe_drained(self, step: int, scalars: Dict[str, float]) -> None:
@@ -270,6 +327,14 @@ class BudgetController:
             out["budget_remaining_bytes"] = int(
                 self.budget_bytes - self.spent_bytes
             )
+        if getattr(self.cfg, "fleet_enabled", False):
+            out["fleet_width"] = int(
+                getattr(self.session, "_fleet_width", self.cfg.num_workers)
+            )
+        if self.policy.ADAPTS_ASYNC:
+            out["async_k"] = int(self.async_k)
+            out["async_c"] = int(self.async_c)
+            out["retunes"] = int(self.retunes)
         return out
 
     def describe(self) -> str:
@@ -302,22 +367,32 @@ class BudgetController:
 
     # -- checkpoint state --------------------------------------------------
     def state_blob(self) -> np.ndarray:
+        # fleet width at capture (v3, ADVISORY — see load): -1 marks a
+        # run with no fleet events, so forensics can tell "fleet off"
+        # from "fleet at base width"
+        fleet_w = (
+            int(getattr(self.session, "_fleet_width", self.cfg.num_workers))
+            if getattr(self.cfg, "fleet_enabled", False) else -1
+        )
         return np.asarray(
             [_BLOB_VERSION, self.session.active_rung, self.switches,
              self.rounds_seen, self.spent_up, self.spent_down,
-             self.last_switch_round, self.min_rung, *self.policy.state()],
+             self.last_switch_round, self.min_rung, fleet_w,
+             self.async_k, self.async_c, self.retunes,
+             self.last_retune_round, *self.policy.state()],
             np.float64,
         )
 
     def load_state_blob(self, blob) -> None:
         blob = np.asarray(blob, np.float64)
         version = int(blob[0])
-        if version not in (1, _BLOB_VERSION):
+        if version not in (1, 2, _BLOB_VERSION):
             raise ValueError(
                 f"controller checkpoint blob version {version} != "
                 f"{_BLOB_VERSION} — checkpoint from an incompatible build"
             )
-        fixed = _BLOB_FIXED_V1 if version == 1 else _BLOB_FIXED
+        fixed = {1: _BLOB_FIXED_V1, 2: _BLOB_FIXED_V2,
+                 _BLOB_VERSION: _BLOB_FIXED}[version]
         want = fixed + self.policy.STATE_SLOTS
         if blob.shape != (want,):
             raise ValueError(
@@ -350,6 +425,17 @@ class BudgetController:
         # checkpoint resume still adopts the saved floor exactly.
         self.min_rung = max(self.min_rung,
                             0 if version == 1 else int(blob[7]))
+        if version >= 3:
+            # blob[8] (fleet width at capture) is ADVISORY: the session
+            # re-derives the width from the round schedule in
+            # sync_round_clock, which runs on every restore path — a
+            # stale width here must never override the pure schedule
+            self.async_k = int(blob[9])
+            self.async_c = int(blob[10])
+            self.retunes = int(blob[11])
+            self.last_retune_round = int(blob[12])
+            for fn in self._retune_listeners:
+                fn(self.last_retune_round, self.async_k, self.async_c)
         self.policy.load_state(tuple(blob[fixed:]))
 
 

@@ -16,6 +16,14 @@ validation), enforced by scripts/check_mode_dispatch.py:
                          remaining-budget/remaining-rounds allowance, so
                          the run drops down the ladder as the ledger's
                          cumulative bytes approach the cap.
+  * ``staleness_aware`` — closed loop on the buffered-async telemetry
+                         (``async/staleness_mean`` band, plus the
+                         normalized buffer backlog): walks the ladder
+                         DOWN (cheaper rung) while cohorts arrive stale,
+                         climbs back when they are fresh, and adapts the
+                         engine's (K, C) pair toward the target band via
+                         the controller's retune listeners. asyncfed-only
+                         (Config-validated).
   * ``ef_feedback``    — closed loop on the error-feedback telemetry
                          (``diag/ef_residual_norm`` slope, plus any level-2
                          ``*_rel_err`` fidelity scalar): climbs to a more
@@ -39,7 +47,8 @@ from __future__ import annotations
 import math
 from typing import Dict, Optional, Tuple
 
-CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
+CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback",
+                    "staleness_aware")
 
 _SCHEDULE_GRAMMAR = (
     'comma-separated "A-B=rung" round ranges (B empty = open-ended, '
@@ -118,7 +127,9 @@ class DecisionContext:
                  num_rungs: int, round_bytes, spent_bytes: int,
                  budget_bytes: Optional[int], last_switch_round: int,
                  hysteresis: int, staleness_mean: Optional[float] = None,
-                 effective_participation: Optional[float] = None):
+                 effective_participation: Optional[float] = None,
+                 buffer_fill: Optional[float] = None,
+                 num_workers: Optional[int] = None):
         self.step = step
         self.num_rounds = num_rounds
         self.rung = rung
@@ -131,11 +142,16 @@ class DecisionContext:
         self.last_switch_round = last_switch_round
         self.hysteresis = hysteresis
         # v8 buffered-async per-update signals (asyncfed/engine.py):
-        # None on synchronous rounds. Available to policies as observables
-        # — none of the shipped policies key decisions off them yet, so
-        # sync/async rung sequences stay comparable run-to-run.
+        # None on synchronous rounds. ``staleness_aware`` keys its rung
+        # walk and (K, C) retunes off them; every other shipped policy
+        # ignores them, so its sync/async rung sequences stay comparable
+        # run-to-run. ``buffer_fill`` is the RAW delivered-unconsumed
+        # count after the fire (asyncfed/schedule.py buffer_fill_after) —
+        # consumers normalize by K themselves.
         self.staleness_mean = staleness_mean
         self.effective_participation = effective_participation
+        self.buffer_fill = buffer_fill
+        self.num_workers = num_workers
 
 
 class ControlPolicy:
@@ -145,6 +161,11 @@ class ControlPolicy:
     # float64 slots this policy persists in the controller's checkpoint
     # blob (beyond the controller's own); loaded back verbatim on resume
     STATE_SLOTS = 0
+    # capability, not a mode string (scripts/check_mode_dispatch.py):
+    # True when decide_async may move the asyncfed (K, C) pair — the
+    # controller then emits control/async_k|async_c|retunes and the
+    # engine registers a retune listener
+    ADAPTS_ASYNC = False
 
     def __init__(self, cfg):
         self.cfg = cfg
@@ -158,6 +179,12 @@ class ControlPolicy:
 
     def decide(self, ctx: DecisionContext) -> int:
         return ctx.rung
+
+    def decide_async(self, ctx: DecisionContext, k: int, c: int):
+        """Propose the asyncfed (buffer K, concurrency C) pair for the
+        NEXT update — called by the controller only when ``ADAPTS_ASYNC``
+        (and clamped/hysteresis-gated there). Base: hold."""
+        return k, c
 
     def state(self) -> tuple:
         return ()
@@ -303,8 +330,73 @@ class EfFeedbackPolicy(ControlPolicy):
         self.prev_ef, self.last_slope, self.last_fidelity = map(opt, slots)
 
 
+class StalenessAwarePolicy(ControlPolicy):
+    """Closed loop on the buffered-async staleness telemetry.
+
+    Rung walk (``decide``): when ``async/staleness_mean`` sits above
+    ``control_staleness_hi``, cohorts are arriving so late that their
+    gradients mostly fight the server's newer parameters — spend FEWER
+    bytes on them (one rung cheaper per decision); below
+    ``control_staleness_lo`` the fleet is keeping up and the loop climbs
+    back toward full fidelity. The band is Config-validated open
+    (``hi > lo``) and every move honors ``control_hysteresis``, so a
+    signal inside the band holds and the loop cannot flap every update
+    (tests/test_control.py pins the property, like ``ef_feedback``).
+
+    (K, C) retune (``decide_async``): drives the normalized buffer
+    backlog ``buffer_fill / K`` into the ``[control_fill_lo,
+    control_fill_hi]`` band — backlog over the band grows K (each server
+    aggregate absorbs more of the queue), staleness over its band sheds
+    concurrency toward 1 (fewer in-flight cohorts age less) then shrinks
+    K once the backlog allows, and a fresh fleet restores concurrency up
+    to the configured ``--async_concurrency``. One move per decision;
+    the controller clamps to ``1 <= K <= num_workers`` and applies the
+    retune hysteresis.
+
+    Stateless on purpose (``STATE_SLOTS = 0``): every decision is a pure
+    function of the per-update DecisionContext, so checkpoint resume
+    needs only the controller's own (K, C, retunes) slots."""
+
+    name = "staleness_aware"
+    ADAPTS_ASYNC = True
+
+    def decide(self, ctx: DecisionContext) -> int:
+        if (ctx.last_switch_round >= 0
+                and ctx.step - ctx.last_switch_round < ctx.hysteresis):
+            return ctx.rung
+        stale = ctx.staleness_mean
+        if stale is None:
+            return ctx.rung  # synchronous round / nothing fired yet
+        cfg = self.cfg
+        if stale > cfg.control_staleness_hi:
+            return min(ctx.rung + 1, ctx.num_rungs - 1)  # cheaper
+        if stale < cfg.control_staleness_lo:
+            return max(ctx.rung - 1, 0)  # climb back to fidelity
+        return ctx.rung
+
+    def decide_async(self, ctx: DecisionContext, k: int, c: int):
+        stale, fill = ctx.staleness_mean, ctx.buffer_fill
+        if stale is None or fill is None:
+            return k, c
+        cfg = self.cfg
+        norm = float(fill) / max(k, 1)
+        if norm > cfg.control_fill_hi and ctx.num_workers is not None \
+                and k < ctx.num_workers:
+            return k + 1, c  # backlog over band: absorb more per fire
+        if stale > cfg.control_staleness_hi:
+            if c > 1:
+                return k, c - 1  # fewer in-flight cohorts age less
+            if norm <= cfg.control_fill_lo and k > 1:
+                return k - 1, c  # starved AND stale: fire smaller buffers
+            return k, c
+        if stale < cfg.control_staleness_lo and c < cfg.async_concurrency:
+            return k, c + 1  # fresh fleet: restore configured concurrency
+        return k, c
+
+
 POLICIES = {
-    p.name: p for p in (FixedPolicy, BudgetPacingPolicy, EfFeedbackPolicy)
+    p.name: p for p in (FixedPolicy, BudgetPacingPolicy, EfFeedbackPolicy,
+                        StalenessAwarePolicy)
 }
 
 

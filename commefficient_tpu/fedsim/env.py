@@ -26,6 +26,11 @@ from commefficient_tpu.fedsim.availability import (
 from commefficient_tpu.fedsim.faults import (
     ChaosEvent,
     apply_chaos,
+    fleet_shrink_at,
+    fleet_transitions,
+    fleet_width_at,
+    fleet_widths,
+    has_fleet,
     parse_chaos,
     preempt_requested,
     validate_chaos_rounds,
@@ -63,6 +68,14 @@ class FedEnvironment:
         # predate the poisson model's knob
         self.arrival_rate = float(getattr(cfg, "arrival_rate", 1.0))
         self.plan: Tuple[ChaosEvent, ...] = parse_chaos(cfg.chaos)
+        # elastic fleet (README "Elastic fleet"): the width schedule is a
+        # pure function of (plan, num_workers) — precompute the change
+        # points so fleet_stats is O(#transitions) per round
+        self.has_fleet = has_fleet(self.plan)
+        self.transitions: Tuple[Tuple[int, int], ...] = (
+            fleet_transitions(self.plan, self.num_workers)
+            if self.has_fleet else ()
+        )
 
     def describe(self) -> str:
         bits = [f"availability={self.availability}"]
@@ -77,6 +90,45 @@ class FedEnvironment:
         callable only where the run length is known (the train entries)."""
         validate_chaos_rounds(self.plan, num_rounds)
 
+    # -- elastic fleet (all pure in round_idx; numpy/host only) ----------
+
+    def width_at(self, round_idx: int) -> int:
+        """The realized fleet width at ``round_idx`` — ``num_workers``
+        when no fleet events are scheduled."""
+        if not self.has_fleet:
+            return self.num_workers
+        return fleet_width_at(self.plan, self.num_workers, round_idx)
+
+    def widths(self) -> Tuple[int, ...]:
+        """Every width the run realizes (base first) — the session's AOT
+        prewarm set."""
+        return fleet_widths(self.plan, self.num_workers)
+
+    def shrink_at(self, round_idx: int) -> Optional[int]:
+        """W' of a shrink event opening at ``round_idx``, else None."""
+        if not self.has_fleet:
+            return None
+        return fleet_shrink_at(self.plan, round_idx)
+
+    def fleet_stats(self, round_idx: int) -> dict:
+        """The ``fleet/*`` telemetry scalars for one round (empty when no
+        fleet events — callers keep their constant key set either way).
+        Schedule-derived, never runtime state, so rollback-replayed
+        rounds re-emit identical values."""
+        if not self.has_fleet:
+            return {}
+        resizes = 0
+        last = -1
+        for r, _w in self.transitions:
+            if r <= round_idx:
+                resizes += 1
+                last = r
+        return {
+            "fleet/width": float(self.width_at(round_idx)),
+            "fleet/resizes": float(resizes),
+            "fleet/last_resize_round": float(last),
+        }
+
     def round_envs(self, start: int, stop: int):
         """Yield ``round_env(r)`` for r in [start, stop) — the pipeline
         prefetcher's (and bench's) bulk-realization form. Each env is a
@@ -88,15 +140,20 @@ class FedEnvironment:
         for r in range(start, stop):
             yield self.round_env(r)
 
-    def round_env(self, round_idx: int, replay: bool = False) -> RoundEnv:
+    def round_env(self, round_idx: int, replay: bool = False,
+                  width: Optional[int] = None) -> RoundEnv:
         """Realize round ``round_idx``'s masks + telemetry scalars —
         deterministic and resume-stable from (seed, round_idx). Pure and
         thread-safe: a fresh rng per call, nothing mutated (see
         ``round_envs``). ``replay=True`` marks a round re-executed after a
         resilience/ rollback: the transient nan_client injection is
         suppressed (faults.apply_chaos), every other draw — and therefore
-        every mask — is bit-identical to the first pass."""
-        W = self.num_workers
+        every mask — is bit-identical to the first pass.
+
+        ``width`` overrides the realized fleet width (the session's
+        prewarm path realizes non-current widths ahead of time); by
+        default the round's masks have ``width_at(round_idx)`` slots."""
+        W = self.width_at(round_idx) if width is None else int(width)
         rng = round_rng(self.seed, round_idx)
         avail = sample_availability(
             self.availability, rng, round_idx,
@@ -123,6 +180,10 @@ class FedEnvironment:
             # host-side, constant key set, never traced
             "fedsim/preempt": float(preempt_requested(self.plan, round_idx)),
         }
+        # fleet/* ride the same constant-key stats dict (3 extra keys for
+        # the whole run iff any fleet event is scheduled) — the ledger and
+        # controller read fleet/width to bill at the realized width
+        stats.update(self.fleet_stats(round_idx))
         return RoundEnv(
             live=live.astype(np.float32),
             corrupt=corrupt.astype(np.float32),

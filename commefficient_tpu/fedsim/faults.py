@@ -37,6 +37,36 @@ Plan grammar (the ``--chaos`` flag): comma-separated events,
                                    of a real SIGTERM, so the e2e test is
                                    not timing-dependent.
 
+Fleet events (the elastic-fleet subsystem — README "Elastic fleet"):
+
+  * ``resize@W'[:rounds=A-B]``   — the fleet runs at width W' during
+                                   rounds A..B (from A onward when the
+                                   range is open/omitted). A SCHEDULED
+                                   zero-downtime transition: the session
+                                   swaps to the AOT-prewarmed width-W'
+                                   round program, no recovery involved.
+  * ``leave@n`` / ``join@n``     — delta sugar: n workers leave (width
+                                   -= n) or join (width += n) for the
+                                   event's window, relative to the width
+                                   in effect as the window opens.
+  * ``shrink@W'[:rounds=A-B]``   — an UNSCHEDULED mid-round worker loss:
+                                   on round A's FIRST execution the
+                                   session raises ``FleetShrinkError``
+                                   (a ``DivergenceError`` the resilience
+                                   manager recovers from — rollback to
+                                   the newest vault snapshot, re-enter
+                                   at width W'); the replay then runs
+                                   the window at W' without raising,
+                                   exactly the transient-fault
+                                   semantics ``nan_client`` pins.
+
+  Fleet events COMPOSE in start order: the width at round r folds every
+  active event over the base ``--num_workers`` (resize/shrink set,
+  leave/join add), so ``leave@4:rounds=2-,join@2:rounds=6-`` runs
+  W, W-4, W-2 across the three segments. ``validate_fleet`` checks the
+  REALIZED width at every boundary (positive, ``% num_devices == 0``,
+  ``<= num_workers`` — the provisioned maximum the sampler draws at).
+
 Example: ``--chaos "dropout@0.3:rounds=50-100,nan_client@120"``.
 
 Parsing is syntax-and-range validated here (``utils.config`` calls
@@ -60,11 +90,15 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-CHAOS_KINDS = ("dropout", "straggler", "nan_client", "preempt")
+CHAOS_KINDS = ("dropout", "straggler", "nan_client", "preempt",
+               "resize", "leave", "join", "shrink")
+# the elastic-fleet subset: events that change the per-round fleet width
+FLEET_KINDS = ("resize", "leave", "join", "shrink")
 
 _GRAMMAR = (
-    'comma-separated "kind@value[:rounds=A-B]" with kind in '
-    f'{CHAOS_KINDS}, e.g. "dropout@0.3:rounds=50-100,nan_client@120"'
+    'comma-separated "kind@value[:rounds=A-B]" (B empty = open-ended) '
+    f'with kind in {CHAOS_KINDS}, e.g. '
+    '"dropout@0.3:rounds=50-100,nan_client@120,resize@4:rounds=3-5"'
 )
 
 
@@ -115,7 +149,9 @@ def parse_chaos(spec: str) -> Tuple[ChaosEvent, ...]:
             a, sep, b = rng_s.partition("-")
             try:
                 start = int(a)
-                end = int(b) if sep else start
+                # "A-B" -> A..B inclusive; "A-" -> open-ended from A;
+                # "A" -> the single round A
+                end = (int(b) if b.strip() else None) if sep else start
             except ValueError:
                 raise _fail(spec, f"rounds={rng_s!r} is not A-B") from None
             if start < 0 or (end is not None and end < start):
@@ -137,6 +173,14 @@ def parse_chaos(spec: str) -> Tuple[ChaosEvent, ...]:
                 raise _fail(spec, f"{kind}@{val_s} must name a "
                                   "non-negative integer round")
             start = end = int(value)
+        elif kind in FLEET_KINDS:
+            # resize/shrink take the new WIDTH, leave/join a worker
+            # DELTA — always a positive integer count; the realized
+            # per-round widths are validated by validate_fleet (Config
+            # owns the device/worker counts this needs)
+            if value < 1 or value != int(value):
+                raise _fail(spec, f"{kind}@{val_s} must name a positive "
+                                  "integer worker count")
         else:
             if not 0.0 <= value < 1.0:
                 raise _fail(spec, f"{kind} probability {value} outside "
@@ -221,3 +265,137 @@ def has_preempt(plan: Tuple[ChaosEvent, ...]) -> bool:
     """True iff the plan schedules any preemption — one of the
     resilience/ construction gates (build_resilience)."""
     return any(ev.kind == "preempt" for ev in plan)
+
+
+# --------------------------------------------------------------------------
+# Elastic fleet — deterministic per-round widths (README "Elastic fleet").
+#
+# The fleet width at round r is a PURE function of (plan, num_workers, r):
+# no runtime state, so vault rollback and checkpoint resume land on the
+# correct width by just re-evaluating the schedule at the restored round
+# clock. The session realizes transitions by swapping prewarmed per-width
+# round programs (parallel/api.py); everything here is host-side numpy.
+# --------------------------------------------------------------------------
+
+
+def fleet_plan(plan: Tuple[ChaosEvent, ...]) -> Tuple[ChaosEvent, ...]:
+    """The fleet-event subset of a chaos plan, in start order (ties keep
+    plan order — the fold below depends on this being deterministic)."""
+    evs = [ev for ev in plan if ev.kind in FLEET_KINDS]
+    return tuple(sorted(evs, key=lambda ev: ev.start))
+
+
+def has_fleet(plan: Tuple[ChaosEvent, ...]) -> bool:
+    """True iff the plan schedules any fleet event — the construction
+    gate for the session's width ladder (Config.fleet_enabled)."""
+    return any(ev.kind in FLEET_KINDS for ev in plan)
+
+
+def fleet_width_at(plan: Tuple[ChaosEvent, ...], num_workers: int,
+                   round_idx: int) -> int:
+    """The realized fleet width at ``round_idx``: fold every ACTIVE fleet
+    event over the base ``num_workers`` in start order — resize/shrink SET
+    the width, leave/join ADD a delta. Pure in (plan, num_workers,
+    round_idx); see the module docstring for the composition rule."""
+    w = int(num_workers)
+    for ev in fleet_plan(plan):
+        if not ev.active(round_idx):
+            continue
+        n = int(ev.value)
+        if ev.kind in ("resize", "shrink"):
+            w = n
+        elif ev.kind == "leave":
+            w -= n
+        else:  # join
+            w += n
+    return w
+
+
+def fleet_boundaries(plan: Tuple[ChaosEvent, ...]) -> Tuple[int, ...]:
+    """Sorted candidate rounds where the width MAY change: round 0 plus
+    every fleet event's window edges (start, and end+1 for closed
+    windows). The width is constant between consecutive boundaries."""
+    marks = {0}
+    for ev in fleet_plan(plan):
+        marks.add(ev.start)
+        if ev.end is not None:
+            marks.add(ev.end + 1)
+    return tuple(sorted(marks))
+
+
+def fleet_transitions(plan: Tuple[ChaosEvent, ...],
+                      num_workers: int) -> Tuple[Tuple[int, int], ...]:
+    """The rounds where the width actually CHANGES, as sorted
+    ``(round, new_width)`` pairs — the schedule behind the
+    ``fleet/resizes`` / ``fleet/last_resize_round`` scalars."""
+    out = []
+    for r in fleet_boundaries(plan):
+        if r < 1:
+            continue
+        w = fleet_width_at(plan, num_workers, r)
+        if w != fleet_width_at(plan, num_workers, r - 1):
+            out.append((r, w))
+    return tuple(out)
+
+
+def fleet_widths(plan: Tuple[ChaosEvent, ...],
+                 num_workers: int) -> Tuple[int, ...]:
+    """Every width the run realizes, base first then ascending — the set
+    the session AOT-prewarms a round program for."""
+    ws = {fleet_width_at(plan, num_workers, r) for r in
+          fleet_boundaries(plan)}
+    base = int(num_workers)
+    ws.add(base)
+    return (base,) + tuple(sorted(ws - {base}))
+
+
+def fleet_shrink_at(plan: Tuple[ChaosEvent, ...],
+                    round_idx: int) -> Optional[int]:
+    """The width W' of a ``shrink`` event whose window OPENS at
+    ``round_idx`` (else None) — the session raises ``FleetShrinkError``
+    on that round's first execution; replays run at W' quietly."""
+    for ev in fleet_plan(plan):
+        if ev.kind == "shrink" and ev.start == round_idx:
+            return int(ev.value)
+    return None
+
+
+def validate_fleet(plan: Tuple[ChaosEvent, ...], *, num_workers: int,
+                   num_devices: int) -> None:
+    """Reject fleet plans whose REALIZED width breaks a session invariant
+    at any boundary round. Raises ValueError naming the blocker. Checked
+    at Config construction (utils.config), where the worker/device counts
+    live."""
+    for r in fleet_boundaries(plan):
+        w = fleet_width_at(plan, num_workers, r)
+        if w < 1:
+            raise ValueError(
+                f"fleet plan realizes width {w} at round {r} — every "
+                "composed width must stay >= 1 (too many leave@n deltas?)"
+            )
+        if w % num_devices != 0:
+            raise ValueError(
+                f"fleet plan realizes width {w} at round {r}, which is "
+                f"not a multiple of num_devices={num_devices} — every "
+                "width must shard evenly over the fixed device mesh "
+                "(the mesh never resizes; only the per-round worker "
+                "multiplexing does)"
+            )
+        if w > num_workers:
+            raise ValueError(
+                f"fleet plan realizes width {w} at round {r}, above the "
+                f"provisioned maximum --num_workers={num_workers} — the "
+                "sampler draws cohorts at the base width, so joins can "
+                "only return capacity that earlier events removed"
+            )
+    for ev in fleet_plan(plan):
+        if ev.kind != "shrink":
+            continue
+        before = fleet_width_at(plan, num_workers, max(ev.start - 1, 0))
+        if ev.start == 0 or int(ev.value) >= before:
+            raise ValueError(
+                f"shrink@{int(ev.value)}:rounds={ev.start}- must model a "
+                f"LOSS: it needs a round >= 1 to roll back over and a "
+                f"width strictly below the {before} in effect before it "
+                "(use resize@W' for scheduled, non-faulting changes)"
+            )

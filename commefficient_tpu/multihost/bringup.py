@@ -21,10 +21,58 @@ on single-host configs).
 
 from __future__ import annotations
 
+import os
+import time
+
 from commefficient_tpu.parallel.mesh import (
     initialize_distributed,
     make_mesh,
 )
+
+
+def _coordinator_address() -> str:
+    """Best-effort name of the coordinator this process is dialing, for
+    the bring-up error message (same env precedence as
+    ``initialize_distributed``'s multi-host detection)."""
+    for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        v = os.environ.get(k)
+        if v:
+            return v
+    return "<unset>"
+
+
+def _connect_with_retry(cfg) -> bool:
+    """``initialize_distributed`` under a bounded retry-with-backoff.
+
+    Elastic-fleet bring-up robustness: pod workers rarely start in
+    lockstep, and a worker that dials before the coordinator is listening
+    gets a hard connect error. ``cfg.distributed_connect_retries`` is the
+    TOTAL attempt budget (default 3); backoff doubles from 1s. The final
+    failure names the coordinator address and the attempts spent, so a
+    dead coordinator reads as exactly that — not a mystery RPC trace.
+    """
+    attempts = max(1, int(getattr(cfg, "distributed_connect_retries", 3)))
+    delay = 1.0
+    last = None
+    for attempt in range(1, attempts + 1):
+        try:
+            return initialize_distributed()
+        # jax.distributed surfaces connect failures as RuntimeError (XLA
+        # status) — config errors below raise from OUR checks, after
+        # initialize_distributed returns, so they are never retried
+        # lint: allow[exception-hygiene] re-raised with context after
+        # the attempt budget is spent
+        except Exception as e:
+            last = e
+            if attempt < attempts:
+                time.sleep(delay)
+                delay *= 2.0
+    raise RuntimeError(
+        f"could not join the multi-host coordinator at "
+        f"{_coordinator_address()} after {attempts} attempt(s) "
+        f"(--distributed_connect_retries): {last}"
+    ) from last
 
 
 def initialize_multihost(cfg) -> bool:
@@ -34,14 +82,16 @@ def initialize_multihost(cfg) -> bool:
     * ``cfg.distributed`` False: touches nothing, returns False — the
       mesh-faked twin and every single-host run land here.
     * ``cfg.distributed`` True: runs the env-driven
-      ``jax.distributed.initialize`` bring-up and fails LOUDLY if the
-      coordinator env is absent (the alternative is a one-process run
-      silently pretending to be a pod) or if the joined process count
-      disagrees with ``cfg.num_hosts``.
+      ``jax.distributed.initialize`` bring-up under a bounded
+      retry-with-backoff (``cfg.distributed_connect_retries`` total
+      attempts — pod workers rarely start in lockstep) and fails LOUDLY
+      if the coordinator env is absent (the alternative is a one-process
+      run silently pretending to be a pod) or if the joined process
+      count disagrees with ``cfg.num_hosts``.
     """
     if not getattr(cfg, "distributed", False):
         return False
-    joined = initialize_distributed()
+    joined = _connect_with_retry(cfg)
     if not joined:
         raise RuntimeError(
             "--distributed was set but no multi-host coordinator is "

@@ -90,6 +90,26 @@ class HostTopology:
         lo, hi = self.client_range
         return hi - lo
 
+    def at_width(self, width: int) -> "HostTopology":
+        """This host's topology at a REALIZED fleet width (elastic fleet,
+        schema v13): the global cohort dimension narrows to ``width``
+        worker slots, re-split host-major; chip and client ownership are
+        untouched — the mesh never resizes, so width re-partitioning is
+        purely a slot-range change (the per-host data plane feeds fewer
+        rows, from the same clients, onto the same chips)."""
+        w = int(width)
+        if w == self.num_workers:
+            return self
+        return HostTopology(
+            num_hosts=self.num_hosts,
+            host_id=self.host_id,
+            num_workers=w,
+            num_clients=self.num_clients,
+            chips_per_host=self.chips_per_host,
+            slot_range=slot_partition(w, self.num_hosts, self.host_id),
+            client_range=self.client_range,
+        )
+
     def owns_client(self, client_id: int) -> bool:
         lo, hi = self.client_range
         return lo <= int(client_id) < hi

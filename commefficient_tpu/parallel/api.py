@@ -68,7 +68,7 @@ class _Rung:
 
     __slots__ = ("cfg", "label", "spec", "compressor", "round_fn",
                  "sketch_decode_resolved", "aggregate_resolved",
-                 "round_idx_fn")
+                 "round_idx_fn", "width_fns", "width_idx_fns")
 
     def __init__(self, cfg, label, spec, compressor, round_fn,
                  sketch_decode_resolved, aggregate_resolved):
@@ -80,6 +80,12 @@ class _Rung:
         self.sketch_decode_resolved = sketch_decode_resolved
         self.aggregate_resolved = aggregate_resolved  # "sparse" | "dense"
         self.round_idx_fn = None
+        # elastic fleet (README "Elastic fleet"): one round program per
+        # NON-BASE realized width, keyed by width — empty unless
+        # cfg.fleet_enabled (the base width stays on round_fn above, so a
+        # fleet-less session is bit-identical to the legacy build)
+        self.width_fns = {}
+        self.width_idx_fns = {}
 
     @property
     def sparse_state(self) -> bool:
@@ -223,6 +229,20 @@ class FederatedSession:
         else:
             self.rungs = [self._build_rung(cfg, "")]
             self.active_rung = 0
+        # ---- elastic fleet (fedsim resize/leave/join) --------------------
+        # Every realized fleet width gets its own round program PER RUNG
+        # (its own sentinel stream, "round_fn[label][wN]"), built here and
+        # AOT-prewarmed like the rung ladder — a width transition is then
+        # a dispatch-table lookup, never a trace (xla/retraces stays 0
+        # across shrink AND grow). Gated on cfg.fleet_enabled: a fleet-less
+        # config builds NOTHING here (golden-parity discipline).
+        self._fleet_width = cfg.num_workers
+        self._fleet_shrink_recoveries = 0
+        self._fleet_resize_ms = 0.0
+        if cfg.fleet_enabled:
+            for fr in self.rungs:
+                for w in self.fedsim_env.widths()[1:]:
+                    fr.width_fns[w] = self._build_width_fn(fr, w)
         rung = self.rungs[self.active_rung]
         self.spec = rung.spec
         # session-owned compressor instance (the active rung's): validates
@@ -529,9 +549,97 @@ class FederatedSession:
         self.compressor = new.compressor
         self.sketch_decode_resolved = new.sketch_decode_resolved
         self.aggregate_resolved = new.aggregate_resolved
-        self.round_fn = new.round_fn
+        self._select_programs()
+
+    # -- elastic fleet (per-width round programs; README "Elastic fleet") --
+    def _width_cfg(self, rcfg: Config, w: int) -> Config:
+        """``rcfg`` with ``num_workers = w`` — the trace-time config for
+        one non-base fleet width's round program. Bypasses
+        ``__post_init__`` deliberately: the base config already validated
+        everything width-independent, ``validate_fleet`` already proved
+        ``w`` device-compatible, and re-validating the UNCHANGED chaos
+        plan against the narrowed width would spuriously reject it (the
+        plan's widths are relative to the BASE fleet)."""
+        import copy
+
+        wcfg = copy.copy(rcfg)
+        object.__setattr__(wcfg, "num_workers", int(w))
+        return wcfg
+
+    def _build_width_fn(self, rung: _Rung, w: int):
+        """One rung's host-batch round program traced for fleet width
+        ``w``, on its own RetraceSentinel stream — a later transition to
+        ``w`` dispatches this table entry instead of re-tracing."""
+        hook = self.retrace_sentinel.hook_for(
+            _rung_hook_name(rung.label) + f"[w{w}]"
+        )
+        return build_round_fn(
+            self._width_cfg(rung.cfg, w), self._loss_fn, self.unravel,
+            self.mesh, rung.spec, d=self.grad_size, trace_hook=hook,
+        )
+
+    def _select_programs(self) -> None:
+        """Re-point session dispatch at the (active rung x current fleet
+        width) round programs — the ONE place the rung and width tables
+        compose, so rung switches and width transitions cannot disagree
+        about which program runs next."""
+        rung = self.rungs[self.active_rung]
+        if self._fleet_width == self.cfg.num_workers:
+            fn, idx_fn = rung.round_fn, rung.round_idx_fn
+        else:
+            fn = rung.width_fns[self._fleet_width]
+            idx_fn = rung.width_idx_fns.get(self._fleet_width)
+        self.round_fn = fn
         if self._dev_data is not None:
-            self._round_idx_fn = new.round_idx_fn
+            self._round_idx_fn = idx_fn
+
+    def _set_fleet_width(self, w: int) -> None:
+        """Commit a fleet width: table lookup + dispatch swap (no trace —
+        the per-width programs were built at session init and prewarmed).
+        ``_fleet_resize_ms`` accumulates the host-side swap cost so the
+        bench's elastic leg can assert it stays in the microsecond class."""
+        w = int(w)
+        if w == self._fleet_width:
+            return
+        import time
+
+        t0 = time.perf_counter()
+        self._fleet_width = w
+        self._select_programs()
+        self._fleet_resize_ms += (time.perf_counter() - t0) * 1e3
+
+    def _fleet_round_begin(self) -> int:
+        """Fleet bookkeeping at round dispatch: raise ``FleetShrinkError``
+        the FIRST time a shrink event opens (the resilience manager rolls
+        back to the newest vault snapshot and re-enters), then swap
+        dispatch to the round's scheduled width. Returns the realized
+        width — ``num_workers`` whenever no fleet events are scheduled."""
+        env = self.fedsim_env
+        if env is None or not env.has_fleet:
+            return self.cfg.num_workers
+        r = self._round_clock
+        shrink = env.shrink_at(r)
+        if shrink is not None and r >= self._replay_horizon:
+            from commefficient_tpu.telemetry import FleetShrinkError
+
+            # bump the horizon AT the raise: the rollback rewinds the
+            # round clock but never the horizon, so the replayed pass
+            # re-enters at the shrunk width instead of re-losing the
+            # same cohort forever
+            self._replay_horizon = r + 1
+            raise FleetShrinkError(r, shrink, self._fleet_width)
+        self._set_fleet_width(env.width_at(r))
+        return self._fleet_width
+
+    def _base_width_env(self, env):
+        """Round-0 fedsim env at BASE width for prewarm/audit lowering:
+        when the fleet schedule opens a resize at round 0 the default env
+        would realize ``width_at(0)`` mask slots and the base-width
+        lowering would shape-mismatch. Passthrough for explicit envs and
+        fleet-less sessions."""
+        if env is None and self.cfg.fleet_enabled:
+            return self.fedsim_env.round_env(0, width=self.cfg.num_workers)
+        return env
 
     def _commit_rung_leaves(self, rung: _Rung, m, e, x):
         """Re-commit migrated leaves to their mesh shardings (identity
@@ -670,22 +778,49 @@ class FederatedSession:
             batch,
         )
         lr = jnp.float32(lr)
-        fs_env, _ = self._fedsim_round_env(env)
-        extra = []
-        if self._streamer is not None:
-            W = self.cfg.num_workers
-            extra = [
-                jax.ShapeDtypeStruct((W, self.grad_size), np.float32)
+        fs_env, _ = self._fedsim_round_env(self._base_width_env(env))
+
+        def extras(w):
+            if self._streamer is None:
+                return []
+            return [
+                jax.ShapeDtypeStruct((w, self.grad_size), np.float32)
                 if self._streamer.has_vel else (),
-                jax.ShapeDtypeStruct((W, self.grad_size), np.float32)
+                jax.ShapeDtypeStruct((w, self.grad_size), np.float32)
                 if self._streamer.has_err else (),
             ]
+
+        extra = extras(self.cfg.num_workers)
         for rung in self.rungs:
             rung.round_fn.lower(
                 self._rung_state_struct(rung), ids, dev_batch, lr, *extra,
                 env=fs_env,
             )
-        return len(self.rungs)
+        n = len(self.rungs)
+        if not self.cfg.fleet_enabled:
+            return n
+        # the width ladder: lower every non-base width's program against
+        # the SAME round-0 cohort sliced to w rows, with round-0 masks
+        # realized AT width w — the exact signature a transition dispatches
+        for w in self.fedsim_env.widths()[1:]:
+            idsw = jax.device_put(jnp.asarray(cids[:w]),
+                                  self._batch_sharding)
+            bw = jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(np.asarray(a)[:w]),
+                                         self._batch_sharding),
+                batch,
+            )
+            envw, _ = self._fedsim_round_env(
+                self.fedsim_env.round_env(0, width=w)
+            )
+            extraw = extras(w)
+            for rung in self.rungs:
+                rung.width_fns[w].lower(
+                    self._rung_state_struct(rung), idsw, bw, lr, *extraw,
+                    env=envw,
+                )
+                n += 1
+        return n
 
     def prewarm_rungs_indices(self, client_ids, idx, plan, lr: float,
                               env=None) -> int:
@@ -709,13 +844,62 @@ class FederatedSession:
             else ()
         )
         lr = jnp.float32(lr)
-        fs_env, _ = self._fedsim_round_env(env)
+        fs_env, _ = self._fedsim_round_env(self._base_width_env(env))
         for rung in self.rungs:
             rung.round_idx_fn.lower(
                 self._rung_state_struct(rung), self._dev_data, ids, idxd,
                 pl, lr, env=fs_env,
             )
-        return len(self.rungs)
+        n = len(self.rungs)
+        if not self.cfg.fleet_enabled:
+            return n
+        cids = np.asarray(client_ids)
+        idx_h = np.asarray(idx, np.int32)
+        B = idx_h.shape[1]
+        for w in self.fedsim_env.widths()[1:]:
+            idsw = jax.device_put(jnp.asarray(cids[:w]),
+                                  self._batch_sharding)
+            idxw = jax.device_put(jnp.asarray(idx_h[:w]),
+                                  self._batch_sharding)
+            # augmentation-plan rows are per-SAMPLE ([W*B, ...] leading)
+            plw = (
+                tuple(
+                    jax.device_put(jnp.asarray(np.asarray(a)[: w * B]),
+                                   self._replicated)
+                    for a in plan
+                )
+                if plan
+                else ()
+            )
+            envw, _ = self._fedsim_round_env(
+                self.fedsim_env.round_env(0, width=w)
+            )
+            for rung in self.rungs:
+                rung.width_idx_fns[w].lower(
+                    self._rung_state_struct(rung), self._dev_data, idsw,
+                    idxw, plw, lr, env=envw,
+                )
+                n += 1
+        return n
+
+    def prewarm_from_sampler(self, sampler, lr: float) -> int:
+        """``ControlLoop.prewarm`` for controller-less sessions: AOT-lower
+        every (rung x fleet width) round program from the run's REAL
+        round-0 cohort. The train runner calls it when ``cfg.fleet_enabled``
+        and no controller is attached, so the width ladder is always
+        seeded by the time the first transition dispatches — a resize is a
+        table lookup, never a trace."""
+        if self._dev_data is not None:
+            ids, idx, plan = sampler.sample_round_indices(0)
+            return self.prewarm_rungs_indices(ids, idx, plan, lr)
+        ids, batch = sampler.sample_round(0)
+        L = self.cfg.round_microbatches
+        if L:  # fedavg [W, L, B/L, ...] convention
+            batch = {
+                k: v.reshape(v.shape[0], L, v.shape[1] // L, *v.shape[2:])
+                for k, v in batch.items()
+            }
+        return self.prewarm_rungs(ids, batch, lr)
 
     # -- device-resident data (TPU-native; ships only indices per round) ---
     def maybe_attach_data(self, dataset, sampler, augment=None) -> bool:
@@ -768,28 +952,36 @@ class FederatedSession:
         # the legacy "round_idx_fn" sentinel stream)
         for rung in self.rungs:
             rung.round_idx_fn = self._build_round_idx_fn(rung, augment)
-        self._round_idx_fn = self.rungs[self.active_rung].round_idx_fn
+            for w in rung.width_fns:
+                rung.width_idx_fns[w] = self._build_round_idx_fn(
+                    rung, augment, width=w
+                )
+        self._select_programs()
 
-    def raw_round_idx_fn(self, rung: Optional[_Rung] = None, augment=None):
+    def raw_round_idx_fn(self, rung: Optional[_Rung] = None, augment=None,
+                         cfg: Optional[Config] = None):
         """The UNJITTED index-round closure
         ``(state, data, client_ids, idx, plan, lr, env=()) -> (state,
         metrics)`` — the traceable body both the jitted per-round program
         (``_build_round_idx_fn``) and the scan-over-rounds engine's
         ``lax.scan`` body (pipeline/scan_engine.py) wrap, so the two
         dispatch granularities share one round trace by construction.
-        Defaults to the active rung and the attached augmenter."""
+        Defaults to the active rung and the attached augmenter; ``cfg``
+        overrides the trace-time config (the fleet width builds pass the
+        rung config narrowed to ``num_workers = w``)."""
         from commefficient_tpu.parallel.round import build_round_fn as _brf
 
         if rung is None:
             rung = self.rungs[self.active_rung]
         if augment is None:
             augment = self._dev_augment
+        rcfg = rung.cfg if cfg is None else cfg
         raw_round = _brf(
-            rung.cfg, self._loss_fn, self.unravel, self.mesh, rung.spec,
+            rcfg, self._loss_fn, self.unravel, self.mesh, rung.spec,
             _jit=False, d=self.grad_size,
         )
         has_aug = augment is not None
-        L = rung.cfg.round_microbatches  # fedavg [W, L, B/L, ...] convention
+        L = rcfg.round_microbatches  # fedavg [W, L, B/L, ...] convention
 
         def round_idx_fn(state, data, client_ids, idx, plan, lr, env=()):
             W, B = idx.shape
@@ -809,13 +1001,19 @@ class FederatedSession:
 
         return round_idx_fn
 
-    def _build_round_idx_fn(self, rung: _Rung, augment):
-        round_idx_fn = self.raw_round_idx_fn(rung, augment)
+    def _build_round_idx_fn(self, rung: _Rung, augment,
+                            width: Optional[int] = None):
+        hook_name = rung.idx_hook_name
+        wcfg = None
+        if width is not None:  # fleet: this width's own sentinel stream
+            hook_name += f"[w{width}]"
+            wcfg = self._width_cfg(rung.cfg, width)
+        round_idx_fn = self.raw_round_idx_fn(rung, augment, cfg=wcfg)
         # the retrace sentinel watches the OUTER jitted program (the raw
         # round inside it is traced as part of the same trace — hooking
         # both would double-count every legitimate compile)
         return jax.jit(
-            self.retrace_sentinel.wrap(round_idx_fn, rung.idx_hook_name),
+            self.retrace_sentinel.wrap(round_idx_fn, hook_name),
             donate_argnums=(0,),
         )
 
@@ -870,6 +1068,13 @@ class FederatedSession:
         Called after a checkpoint restore replaced ``self.state``; a no-op
         cost otherwise (one scalar fetch, once per restore)."""
         self._round_clock = int(jax.device_get(self.state.step))
+        # every restore path (vault rollback, checkpoint resume) lands
+        # width-correct for free: the fleet schedule is pure in the round
+        # index, so re-applying it here needs no extra bookkeeping
+        if self.fedsim_env is not None and self.fedsim_env.has_fleet:
+            self._set_fleet_width(
+                self.fedsim_env.width_at(self._round_clock)
+            )
 
     def blacklist_clients(self, client_ids) -> np.ndarray:
         """Add ``client_ids`` to the session blacklist
@@ -992,6 +1197,14 @@ class FederatedSession:
         scalars — constant key set across an epoch, as pack_metric_dicts
         requires."""
         stats = dict(fs_stats)
+        if "fleet/width" in stats:
+            # the ONE runtime fleet counter (schema v13): bumped by the
+            # resilience manager when a FleetShrinkError recovery lands —
+            # everything else under fleet/* is schedule-derived in the
+            # fedsim environment, so rollback replay re-emits it exactly
+            stats["fleet/shrink_recoveries"] = float(
+                self._fleet_shrink_recoveries
+            )
         if self.cfg.telemetry_level >= 1:
             stats["xla/retraces"] = float(self.retrace_sentinel.retraces)
             if self.spans is not None:
@@ -1059,6 +1272,17 @@ class FederatedSession:
         """Run one round from device-resident data (see ``attach_data``)."""
         from commefficient_tpu.telemetry.trace import round_trace_id
 
+        w = self._fleet_round_begin()
+        if w != self.cfg.num_workers:
+            # session-owned width slicing: the sampler keeps drawing base-
+            # width cohorts (its draw sequence stays resume-stable); the
+            # round consumes the first w — plan rows are per-sample, so
+            # the slice is w*B there
+            client_ids = np.asarray(client_ids)[:w]
+            idx = idx[:w]
+            if plan:
+                B = idx.shape[1]
+                plan = tuple(a[: w * B] for a in plan)
         tid = round_trace_id(self._round_clock)
         with self._span("device_put", trace_id=tid):
             cids, idxd, pl = self.stage_round_indices(client_ids, idx, plan)
@@ -1097,6 +1321,14 @@ class FederatedSession:
                     lr: float, env=None, cohort=None):
         from commefficient_tpu.telemetry.trace import round_trace_id
 
+        w = self._fleet_round_begin()
+        if w != self.cfg.num_workers:
+            # session-owned width slicing (the sampler stays base-width);
+            # a cohort staged at the base width no longer matches the
+            # sliced ids — drop it and regather the w rows below
+            client_ids = np.asarray(client_ids)[:w]
+            batch = jax.tree.map(lambda a: a[:w], batch)
+            cohort = None
         tid = round_trace_id(self._round_clock)
         with self._span("device_put", trace_id=tid):
             cids, dev_batch = self.stage_round_payload(client_ids, batch)
@@ -1249,7 +1481,7 @@ class FederatedSession:
             # a struct-lowered twin could compile a second layout
             staged = self._streamer.gather(cids)
             args.extend([staged.vel, staged.err])
-        fs_env, _ = self._fedsim_round_env(env)
+        fs_env, _ = self._fedsim_round_env(self._base_width_env(env))
         lowered = self.round_fn.lower(*args, env=fs_env)
         compiled = lowered.compile()
         audit = CompiledRoundAudit.from_compiled(

@@ -125,6 +125,14 @@ class RecoveryManager:
         self.last_restored_extras = snap.extras
         entry["outcome"] = "recovered"
         entry["rollback_to"] = int(snap.step)
+        # elastic-fleet shrink recoveries (schema v13): duck-typed on the
+        # exception so FleetShrinkError needs no import here — the session
+        # counter feeds the fleet/shrink_recoveries scalar, and the entry
+        # records the width the replay re-enters at
+        fleet_w = getattr(exc, "fleet_width", None)
+        if fleet_w is not None:
+            self.session._fleet_shrink_recoveries += 1
+            entry["fleet_width"] = int(fleet_w)
         entry.update(details)
         self.history.append(entry)
         if self.flight is not None:

@@ -66,6 +66,7 @@ from commefficient_tpu.telemetry.diagnostics import (
 )
 from commefficient_tpu.telemetry.flight import (
     DivergenceError,
+    FleetShrinkError,
     FlightRecorder,
     jsonable_scalar,
     jsonable_tree,
@@ -195,7 +196,22 @@ from commefficient_tpu.telemetry.xla_audit import (
 # num_processes >= 1, host_id in [0, num_processes)} REQUIRED exactly
 # when the audited mesh declares a host axis and forbidden on
 # single-host reports, so wall-clock rows always state their topology.
-SCHEMA_VERSION = 12
+# v13 (elastic-fleet PR): the fleet/* scalar namespace, emitted exactly
+# when the chaos plan schedules a fleet event (cfg.fleet_enabled — fixed
+# for a run, so the key set stays constant): fleet/width a positive
+# integer (the round's realized worker width; the ledger bills live
+# bytes against it instead of num_workers), fleet/resizes a
+# non-decreasing integer counter of schedule transitions REALIZED so
+# far, fleet/last_resize_round an integer in {-1} ∪ [0, step] (-1 until
+# the first transition), fleet/shrink_recoveries a non-decreasing
+# integer counter of FleetShrinkError rollbacks survived — all
+# checker-enforced. Width/resizes/last_resize_round are SCHEDULE-
+# derived (pure in round_idx), so rollback-replayed rounds re-emit
+# identical values; shrink_recoveries is the one runtime counter.
+# control/ gains optional async_k/async_c/retunes scalars (positive
+# integer K/C re-tune state + a non-decreasing counter) emitted only
+# when the active policy adapts the asyncfed engine (staleness_aware).
+SCHEMA_VERSION = 13
 
 TELEMETRY_LEVELS = (0, 1, 2)
 
@@ -325,6 +341,7 @@ __all__ = [
     "CompiledRoundAudit",
     "CriticalPath",
     "DivergenceError",
+    "FleetShrinkError",
     "FlightRecorder",
     "PhaseSpans",
     "ProfilerStack",

@@ -48,6 +48,35 @@ class DivergenceError(RuntimeError):
         )
 
 
+class FleetShrinkError(DivergenceError):
+    """Unscheduled worker loss (the fedsim ``shrink@W'`` fleet event):
+    the fleet must continue at ``fleet_width`` < ``prev_width`` workers,
+    and the current round's cohort is gone mid-flight.
+
+    Subclasses ``DivergenceError`` so it rides the resilience manager's
+    existing catch-and-recover loop unchanged (rollback to the newest
+    vault snapshot, then re-enter — the replayed rounds run at the
+    shrunk width, which the width schedule realizes without raising).
+    The message is its own (a shrink is not a numerical blow-up), so the
+    base constructor is bypassed."""
+
+    def __init__(self, step: int, fleet_width: int, prev_width: int):
+        self.step = int(step)
+        self.fleet_width = int(fleet_width)
+        self.prev_width = int(prev_width)
+        self.reason = (f"fleet shrank {prev_width} -> {fleet_width} "
+                       f"workers at round {step}")
+        self.path = None
+        RuntimeError.__init__(
+            self,
+            f"{self.reason}: the in-flight cohort is lost. With a "
+            "resilience policy configured (--recover_policy retry|demote) "
+            "the run rolls back to the newest vault snapshot and "
+            f"re-enters at width {fleet_width}; replayed rounds bill "
+            "exactly once (the ledger rewinds with the rollback)."
+        )
+
+
 def jsonable_scalar(v):
     """Scalars only, NaN/Inf made strict-JSON-legal as "nan"/"inf"/"-inf"
     markers (json.dump emits bare NaN tokens otherwise, which strict

@@ -121,8 +121,12 @@ class CommLedger:
         enough to re-round for any real W). Missing scalars mean full
         participation — a masked ledger stays consistent even if a run
         mixes in fedsim-less rounds."""
-        W = self.num_workers
         scalars = scalars or {}
+        # elastic-fleet rounds bill at the round's REALIZED width (the
+        # fedsim/* rates are relative to it, schema v13) — the base
+        # num_workers otherwise; the fleet/width scalar rides the same
+        # drained dict, so the ledger can never disagree with the run
+        W = int(round(float(scalars.get("fleet/width", self.num_workers))))
         rate = scalars.get("fedsim/participation_rate")
         live = W if rate is None else int(round(float(rate) * W))
         avail = W - int(round(float(scalars.get("fedsim/dropped", 0.0))))

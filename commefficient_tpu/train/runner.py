@@ -191,6 +191,11 @@ def run_train_loop(cfg, session, sampler, hooks: WorkloadHooks,
     if controller is not None:
         controller.prewarm(sampler, float(lr_fn(0)))
         print(controller.describe())
+    elif getattr(cfg, "fleet_enabled", False):
+        # elastic fleet without a control ladder: the width rungs still
+        # need their AOT prewarm (same zero-retrace pin the controller's
+        # prewarm gives ladder runs) before the first resize dispatches
+        session.prewarm_from_sampler(sampler, float(lr_fn(0)))
     # telemetry riders (level >= 1): comm ledger + flight recorder
     ledger, flight = build_telemetry_riders(cfg, session, writer)
     # perf observability (level >= 1): host phase spans + the compiled-

@@ -25,7 +25,8 @@ ERROR_TYPES = ("none", "local", "virtual")
 AVAILABILITY_MODELS = ("always", "bernoulli", "cohort", "poisson", "sine")
 # mirrors the control/ policy registry (control.CONTROL_POLICIES); pinned
 # equal by tests/test_control.py — same no-cycle pattern as MODES
-CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback")
+CONTROL_POLICIES = ("none", "fixed", "budget_pacing", "ef_feedback",
+                    "staleness_aware")
 # mirrors the resilience/ recovery-policy registry (resilience.policy
 # POLICIES); pinned equal by tests/test_mode_dispatch.py — same no-cycle
 # pattern as MODES/CONTROL_POLICIES
@@ -357,6 +358,12 @@ class Config:
     # the pod before any device query. False (default): single-process —
     # mesh-faked multihost (num_hosts > 1) still works without it.
     distributed: bool = False
+    # Bounded retry-with-backoff on the coordinator connect: a pod
+    # bring-up races the coordinator process, so the first refused
+    # connection is normal — retry up to N attempts total (exponential
+    # backoff between them) before failing with an error naming the
+    # coordinator address and the attempt count (multihost/bringup.py).
+    distributed_connect_retries: int = 3
 
     # --- telemetry (commefficient_tpu/telemetry/; TPU-native, no reference
     # analog — the reference logs only train/loss + lr) ---
@@ -427,10 +434,16 @@ class Config:
     # straggler (deadline miss: excluded from aggregation + ledger live
     # bytes, local state untouched), nan_client (corrupt one live client's
     # payload at round value — proves the flight-recorder/DivergenceError
-    # path; DETECTION needs telemetry_level >= 1). Example:
-    # "dropout@0.3:rounds=50-100,nan_client@120". Syntax validated here;
-    # round indices are validated against the run length at train-entry
-    # time (Config cannot know steps_per_epoch).
+    # path; DETECTION needs telemetry_level >= 1), plus the elastic-fleet
+    # events resize@W'/leave@n/join@n (deterministic per-round fleet
+    # widths — the session prewarms a round program per realized width,
+    # so a resize is a dispatch-table swap with zero retraces) and
+    # shrink@W' (unscheduled loss: raises FleetShrinkError for the
+    # resilience manager to roll back and re-enter at W'). Example:
+    # "dropout@0.3:rounds=50-100,nan_client@120". Syntax validated here
+    # (realized fleet widths via _validate_fleet); round indices are
+    # validated against the run length at train-entry time (Config cannot
+    # know steps_per_epoch).
     chaos: str = ""
 
     # --- pipelined round execution (commefficient_tpu/pipeline/;
@@ -546,6 +559,26 @@ class Config:
     # window the policy holds whatever the signals say, so the loop cannot
     # oscillate every round (tests/test_control.py pins the property).
     control_hysteresis: int = 8
+    # staleness_aware band on the drained async/staleness_mean EMA (server
+    # versions a contribution lags by, asyncfed/): above hi -> walk one
+    # rung CHEAPER (stale cohorts' contributions are discounted anyway, so
+    # spend fewer bytes on them) and shed concurrency; below lo -> climb
+    # back / restore concurrency. hi > lo required (the dead band + the
+    # shared control_hysteresis window are the anti-oscillation story,
+    # exactly ef_feedback's).
+    control_staleness_hi: float = 2.0
+    control_staleness_lo: float = 0.5
+    # staleness_aware band on the normalized buffer backlog
+    # (async/buffer_fill / K — contributions still buffered after an
+    # update fires, in buffer units): persistently over fill_hi the
+    # arrival process outpaces the updates -> grow K back toward
+    # --async_buffer (consume more per fire); under fill_lo while
+    # staleness runs hot -> shrink K so updates fire sooner. The policy
+    # adapts K/C toward this band and the controller re-tunes the
+    # asyncfed engine at round granularity (FedBuff arXiv:2106.06639 §5
+    # tunes these statically; ROADMAP item 4 makes it dynamic).
+    control_fill_hi: float = 1.0
+    control_fill_lo: float = 0.25
 
     # --- self-healing training (commefficient_tpu/resilience/;
     # TPU-native — the reference treats every failure as terminal) ---
@@ -844,6 +877,64 @@ class Config:
         self._validate_multihost()
         self._validate_control()
         self._validate_resilience()
+        self._validate_fleet()
+
+    def _validate_fleet(self) -> None:
+        """Elastic-fleet events (fedsim/faults.py FLEET_KINDS in the
+        chaos plan). The realized per-round widths must shard the fixed
+        device mesh and stay within the provisioned maximum
+        (faults.validate_fleet); engines that cannot re-shape a round
+        mid-run are refused here at construction. Runs LAST: it reads
+        gates the other validators resolve."""
+        if not self.fleet_enabled:
+            return
+        from commefficient_tpu.fedsim.faults import (
+            parse_chaos,
+            validate_fleet,
+        )
+
+        plan = parse_chaos(self.chaos)
+        validate_fleet(plan, num_workers=self.num_workers,
+                       num_devices=self.num_devices)
+        if self.asyncfed_enabled:
+            raise ValueError(
+                "fleet events are incompatible with async_buffer > 0: the "
+                "asyncfed schedule pre-simulates every cohort at the fixed "
+                "width W, so a mid-run resize would orphan in-flight "
+                "slots — model elastic participation there with "
+                "availability='poisson' instead"
+            )
+        if self.scan_rounds > 1:
+            raise ValueError(
+                "fleet events are incompatible with scan_rounds > 1: a "
+                "scanned block compiles ONE width for K rounds, and a "
+                "resize inside the block could not swap programs — drop "
+                "scan_rounds or the fleet events"
+            )
+        if self.pipeline_depth > 0:
+            raise ValueError(
+                "fleet events are incompatible with pipeline_depth > 0 "
+                "for now: the prefetcher stages round payloads at the "
+                "base width ahead of the resize decision point — run "
+                "synchronous rounds with the fleet plan"
+            )
+        if self.fsdp:
+            raise ValueError(
+                "fleet events are incompatible with fsdp: the FSDP round "
+                "shards server state [D/W] over the workers axis, so a "
+                "width change would re-partition persistent state, not "
+                "just the round program — use the replicated round"
+            )
+        if any(ev.kind == "shrink" for ev in plan):
+            if not self.recovery_enabled:
+                raise ValueError(
+                    "shrink@W' models an unscheduled worker loss: it "
+                    "raises FleetShrinkError for the resilience manager "
+                    "to roll back and re-enter at W' — set "
+                    "--recover_policy retry|demote (and its "
+                    "--telemetry_level >= 1 requirement), or use "
+                    "resize@W' for a scheduled, non-faulting change"
+                )
 
     def _validate_client_store(self) -> None:
         """Client-state placement flags (clientstore/). Runs FIRST among
@@ -1107,6 +1198,12 @@ class Config:
             raise ValueError(
                 f"num_hosts must be >= 1, got {self.num_hosts}"
             )
+        if self.distributed_connect_retries < 1:
+            raise ValueError(
+                f"distributed_connect_retries must be >= 1 (total connect "
+                f"attempts, not extra retries), got "
+                f"{self.distributed_connect_retries}"
+            )
         if self.distributed and self.num_hosts < 2:
             raise ValueError(
                 "distributed=True runs the jax.distributed bring-up to "
@@ -1272,6 +1369,39 @@ class Config:
                     f"control_ef_down ({self.control_ef_down}): the dead "
                     "band between them is what stops threshold flapping"
                 )
+        if self.control_policy == "staleness_aware":
+            if not self.asyncfed_enabled:
+                raise ValueError(
+                    "control_policy='staleness_aware' acts on the drained "
+                    "async/staleness_mean and async/buffer_fill scalars, "
+                    "which only the asyncfed engine emits — set "
+                    "--async_buffer K (synchronous rounds have staleness 0 "
+                    "by construction, so the policy would never act)"
+                )
+            if len(rungs) < 2:
+                raise ValueError(
+                    "control_policy='staleness_aware' walks the "
+                    "compression ladder by observed staleness — pass "
+                    '--ladder with >= 2 rungs (e.g. "k=60000,30000")'
+                )
+            if self.telemetry_level < 1:
+                raise ValueError(
+                    "control_policy='staleness_aware' consumes drained "
+                    "telemetry scalars — set --telemetry_level >= 1"
+                )
+            if not self.control_staleness_hi > self.control_staleness_lo:
+                raise ValueError(
+                    f"control_staleness_hi ({self.control_staleness_hi}) "
+                    f"must exceed control_staleness_lo "
+                    f"({self.control_staleness_lo}): the dead band between "
+                    "them is what stops threshold flapping"
+                )
+            if not self.control_fill_hi > self.control_fill_lo >= 0:
+                raise ValueError(
+                    f"control_fill_hi ({self.control_fill_hi}) must exceed "
+                    f"control_fill_lo ({self.control_fill_lo}) >= 0 — the "
+                    "normalized backlog band the K/C re-tune targets"
+                )
         if self.control_policy == "fixed":
             from commefficient_tpu.control.policy import parse_schedule
 
@@ -1325,6 +1455,21 @@ class Config:
         keeps the round trace IDENTICAL to a fedsim-less build — the
         golden parity recordings pin that (fedsim/ package docstring)."""
         return self.availability != "always" or bool(self.chaos)
+
+    @property
+    def fleet_enabled(self) -> bool:
+        """True when the chaos plan schedules any elastic-fleet event
+        (resize/leave/join/shrink): the session then prewarms a round
+        program per realized width and swaps programs at the schedule's
+        transition rounds. False constructs NOTHING fleet-related — the
+        fedsim_enabled gate discipline (golden parity and level-0 HLO
+        bit-untouched). Implies ``fedsim_enabled`` (the plan is
+        non-empty)."""
+        if not self.chaos:
+            return False
+        from commefficient_tpu.fedsim.faults import has_fleet, parse_chaos
+
+        return has_fleet(parse_chaos(self.chaos))
 
     @property
     def control_enabled(self) -> bool:

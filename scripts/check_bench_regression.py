@@ -155,6 +155,14 @@ HIGHER_IS_BETTER_SUFFIXES = ("_tokens_per_sec", "_mfu", "_vs_uncompressed",
 # rollback restores never retrace, so ANY non-zero value fails outright
 # (no history or tolerance involved; a relative band on an
 # all-zero trajectory would divide by zero anyway)
+# elastic-fleet PR: sketch_elastic_retraces joins the family through this
+# suffix — a width resize dispatches a prewarmed per-width program, so
+# any retrace across the leg's shrink+grow transitions fails outright.
+# sketch_elastic_samples_per_sec gates UP via the generic suffix;
+# sketch_elastic_resize_ms stays INFORMATIONAL (microsecond-scale
+# dispatch-table swaps make relative bands meaningless, the
+# *_host_stall_ms rule) and sketch_elastic_resizes is schedule
+# configuration, not measurement.
 EXACT_ZERO_SUFFIXES = ("_retraces",)
 
 

@@ -106,14 +106,22 @@ from pathlib import Path
 # perf_report's "multihost" block {num_hosts >= 2, num_processes >= 1,
 # host_id in [0, num_processes)} — REQUIRED when the report's config
 # declares a host axis (num_hosts > 1), FORBIDDEN on single-host
-# reports (enforced below). Older artifacts stay valid.
-KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12)
+# reports (enforced below); v13 (elastic-fleet PR): fleet/* scalar
+# namespace (width a positive integer, resizes / shrink_recoveries
+# non-negative integers — resizes additionally non-decreasing across a
+# flight dump's step-ordered records — last_resize_round an integer
+# >= -1 and <= the record's step: a resize cannot postdate the round
+# reporting it — enforced below) and the staleness_aware control
+# scalars control/async_k (positive integer), control/async_c
+# (positive integer), control/retunes (non-negative integer). Older
+# artifacts stay valid.
+KNOWN_SCHEMA_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13)
 
 # scalar-name schema: bare "lr", or a namespaced name under one of the
 # documented prefixes (README "Observability")
 SCALAR_PREFIXES = ("train/", "val/", "diag/", "comm/", "fedsim/", "xla/",
                    "control/", "pipeline/", "resilience/", "async/",
-                   "clientstore/", "trace/", "multihost/")
+                   "clientstore/", "trace/", "multihost/", "fleet/")
 
 # pinned copy of telemetry.trace.STAGES (this checker imports nothing
 # from the package by design — tests/test_telemetry_schema.py pins the
@@ -399,6 +407,76 @@ def _check_multihost_scalar(name: str, v, where: str) -> None:
         )
 
 
+def _check_fleet_scalar(name: str, v, where: str, step=None) -> None:
+    """v13 ``fleet/*`` value invariants. Host-computed elastic-fleet
+    gauges (parallel/api.py under cfg.fleet_enabled), schedule-derived
+    and never legitimately non-finite: ``width`` is the round's REALIZED
+    worker count (a positive integer — the width schedule never folds to
+    zero, the config validator rejects it); ``resizes`` counts width
+    transitions realized so far and ``shrink_recoveries`` completed
+    shrink rollbacks (whole events); ``last_resize_round`` is the round
+    the width last changed at, -1 before the first transition — and a
+    resize cannot postdate the round reporting it, so when the record's
+    ``step`` is known the value must be <= it."""
+    if not name.startswith("fleet/"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "fleet/width" and (v != int(v) or v < 1):
+        raise SchemaError(
+            f"{where}: fleet/width {v} is not a positive integer — it is "
+            "the round's realized worker count"
+        )
+    if name in ("fleet/resizes", "fleet/shrink_recoveries") and (
+            v != int(v) or v < 0):
+        raise SchemaError(
+            f"{where}: {name} {v} is not a non-negative integer — it "
+            "counts whole width transitions / shrink rollbacks"
+        )
+    if name == "fleet/last_resize_round":
+        if v != int(v) or v < -1:
+            raise SchemaError(
+                f"{where}: fleet/last_resize_round {v} must be an integer "
+                ">= -1 (-1 = the width never changed)"
+            )
+        if step is not None and v > step:
+            raise SchemaError(
+                f"{where}: fleet/last_resize_round {v} postdates the "
+                f"record's step {step} — a resize cannot come from the "
+                "future"
+            )
+
+
+def _check_control_async_scalar(name: str, v, where: str) -> None:
+    """v13 staleness_aware control scalars: the controller's live async
+    geometry (control/controller.py, emitted only under an ADAPTS_ASYNC
+    policy). ``async_k``/``async_c`` are the retuned buffer size and
+    concurrency (positive integers — the controller clamps K >= 1,
+    C >= 1); ``retunes`` counts applied (K, C) changes."""
+    if name not in ("control/async_k", "control/async_c",
+                    "control/retunes"):
+        return
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise SchemaError(
+            f"{where}: {name!r} must be a finite number (host gauge), "
+            f"got {v!r}"
+        )
+    if name == "control/retunes":
+        if v != int(v) or v < 0:
+            raise SchemaError(
+                f"{where}: control/retunes {v} is not a non-negative "
+                "integer — it counts whole applied (K, C) retunes"
+            )
+    elif v != int(v) or v < 1:
+        raise SchemaError(
+            f"{where}: {name} {v} is not a positive integer — the "
+            "controller clamps the async geometry to K >= 1, C >= 1"
+        )
+
+
 def _check_xla_scalar(name: str, v, where: str) -> None:
     """v9 ``xla/exposed_collective_ms`` value invariant: a host-computed
     cumulative gauge (interval arithmetic over the span recorder — never
@@ -518,9 +596,11 @@ def validate_metrics_jsonl(path) -> int:
             _check_multihost_scalar(name, rec["value"], where)
             _check_xla_scalar(name, rec["value"], where)
             _check_trace_scalar(name, rec["value"], where)
+            _check_control_async_scalar(name, rec["value"], where)
             step = _req(rec, "step", int, where)
             if step < 0:
                 raise SchemaError(f"{where}: negative step {step}")
+            _check_fleet_scalar(name, rec["value"], where, step=step)
             _req(rec, "t", (int, float), where)
             n_scalars += 1
     if not saw_header:
@@ -690,6 +770,7 @@ def validate_flight(path) -> dict:
                 raise SchemaError(f"{w}: expected [step, rate] pair")
             _check_scalar_value(pair[1], "fedsim/participation_rate", w)
     last = None
+    last_resizes = None
     for j, r in enumerate(records):
         w = f"{where}:records[{j}]"
         step = _req(r, "step", int, w)
@@ -707,6 +788,20 @@ def validate_flight(path) -> dict:
             _check_multihost_scalar(name, v, w)
             _check_xla_scalar(name, v, w)
             _check_trace_scalar(name, v, w)
+            _check_control_async_scalar(name, v, w)
+            _check_fleet_scalar(name, v, w, step=step)
+        # v13: fleet/resizes counts realized width transitions — over the
+        # dump's step-ordered ring it can only grow (a drop means the
+        # writer re-derived the schedule wrong, or records from two runs
+        # were spliced)
+        if "fleet/resizes" in scalars:
+            rz = scalars["fleet/resizes"]
+            if last_resizes is not None and rz < last_resizes:
+                raise SchemaError(
+                    f"{w}: fleet/resizes fell from {last_resizes} to {rz} "
+                    "— resize counts are non-decreasing in step order"
+                )
+            last_resizes = rz
         if last is not None and step <= last:
             raise SchemaError(f"{w}: records not in increasing step order")
         last = step

@@ -417,3 +417,42 @@ def test_multihost_metrics_registered_and_gated(tmp_path):
     regs, _, _ = mod.check_regression(
         [good], {**BASELINE, "sketch_multihost_vs_singlehost": 0.95})
     assert regs == []
+
+
+def test_elastic_metrics_registered_and_gated(tmp_path):
+    """ISSUE 20 satellite: the elastic bench leg gates on two axes —
+    throughput (generic _samples_per_sec suffix) and the zero-retrace
+    pin (_retraces is exact-zero, no history needed). resize_ms and the
+    resize count stay informational: the first is microsecond-scale
+    dispatch bookkeeping, the second is schedule configuration."""
+    mod = _gate()
+    assert mod.metric_direction("sketch_elastic_samples_per_sec") == "up"
+    assert mod.metric_direction("sketch_elastic_resize_ms") is None
+    assert mod.metric_direction("sketch_elastic_resizes") is None
+    assert mod.metric_direction("sketch_elastic_error") is None
+    # a single record with a nonzero retrace count fails with NO prior
+    # history: the exact-zero gate is absolute, not relative
+    broken = {**BASELINE, "sketch_elastic_samples_per_sec": 900.0,
+              "sketch_elastic_retraces": 1.0}
+    regs, _, _ = mod.check_regression([], broken)
+    assert [r["metric"] for r in regs] == ["sketch_elastic_retraces"]
+    assert regs[0]["direction"] == "exact_zero"
+    _write(tmp_path, "BENCH_r01.json",
+           {**BASELINE, "sketch_elastic_samples_per_sec": 900.0,
+            "sketch_elastic_retraces": 0.0})
+    _write(tmp_path, "BENCH_r02.json", broken)
+    assert mod.main(["--dir", str(tmp_path)]) == 1
+    # detects-regression self-test: elastic throughput collapsing past
+    # tolerance gates and names the metric
+    good = {**BASELINE, "sketch_elastic_samples_per_sec": 1000.0,
+            "sketch_elastic_retraces": 0.0}
+    bad = {**BASELINE, "sketch_elastic_samples_per_sec": 500.0,
+           "sketch_elastic_retraces": 0.0}
+    regs, _, _ = mod.check_regression([good], bad)
+    assert [r["metric"] for r in regs] == ["sketch_elastic_samples_per_sec"]
+    assert regs[0]["direction"] == "up"
+    # healthy pair passes end to end
+    _write(tmp_path, "BENCH_r01.json", good)
+    _write(tmp_path, "BENCH_r02.json",
+           {**good, "sketch_elastic_samples_per_sec": 980.0})
+    assert mod.main(["--dir", str(tmp_path)]) == 0

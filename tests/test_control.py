@@ -490,6 +490,9 @@ def test_budget_remaining_scalar_rides_metrics():
     assert m["control/rung"] == 0.0  # rich budget -> most expensive rung
 
 
+@pytest.mark.slow  # r20 tier budget (~9 s of sketch compiles): the
+# num_cols migration algebra stays tier-1 in the resketch unit test and
+# the switch/zero-retrace mechanics in the fixed-schedule e2e
 def test_num_cols_ladder_switches_table_shapes():
     """A geometry-changing ladder: the switch migrates the sketch tables
     to the new rung's layout and training stays finite — and the switch
@@ -767,3 +770,176 @@ def test_cv_train_ladder_ef_feedback_e2e_with_resume(tmp_path):
     )
     assert set(_scalar_trail(tmp_path / "runB", "xla/retraces").values()) \
         == {0.0}
+
+
+# ---------------------------------------------------------------------------
+# staleness_aware (elastic-fleet PR): rung walk on the async staleness
+# band + live (K, C) retunes through the controller -> engine listener
+# ---------------------------------------------------------------------------
+
+_SA_KW = dict(mode="true_topk", error_type="virtual", telemetry_level=1,
+              control_policy="staleness_aware", ladder="k=30,20,10",
+              async_buffer=4, async_concurrency=2)
+
+
+def _sa_ctx(step, rung, *, stale=None, fill=None, workers=8,
+            last_switch=-1, hysteresis=1):
+    return DecisionContext(
+        step=step, num_rounds=100, rung=rung, num_rungs=3,
+        round_bytes=lambda r: [300, 200, 100][r], spent_bytes=0,
+        budget_bytes=None, last_switch_round=last_switch,
+        hysteresis=hysteresis, staleness_mean=stale, buffer_fill=fill,
+        num_workers=workers,
+    )
+
+
+@pytest.mark.parametrize("kw,msg", [
+    ({**_SA_KW, "async_buffer": 0}, "async_buffer"),
+    ({**_SA_KW, "ladder": "k=30"}, ">= 2"),
+    ({**_SA_KW, "telemetry_level": 0}, "telemetry_level"),
+    ({**_SA_KW, "control_staleness_hi": 0.4,
+      "control_staleness_lo": 0.5}, "must exceed control_staleness_lo"),
+    ({**_SA_KW, "control_fill_hi": 0.2, "control_fill_lo": 0.25},
+     "control_fill"),
+])
+def test_config_rejects_inconsistent_staleness_aware(kw, msg):
+    with pytest.raises(ValueError, match=msg):
+        Config(**kw)
+
+
+def test_staleness_aware_walk_band_and_hysteresis():
+    from commefficient_tpu.control.policy import (
+        ControlPolicy,
+        StalenessAwarePolicy,
+    )
+
+    # the ADAPTS_ASYNC capability is what gates the retune plumbing and
+    # the control/async_* scalars — a class attr, not a name match
+    assert not ControlPolicy.ADAPTS_ASYNC
+    assert StalenessAwarePolicy.ADAPTS_ASYNC
+    p = StalenessAwarePolicy(Config(**_SA_KW))
+    assert p.decide(_sa_ctx(0, 1)) == 1  # synchronous round: hold
+    assert p.decide(_sa_ctx(0, 1, stale=3.0)) == 2    # over band: cheaper
+    assert p.decide(_sa_ctx(0, 2, stale=3.0)) == 2    # clamped at last
+    assert p.decide(_sa_ctx(0, 1, stale=0.1)) == 0    # under: fidelity
+    assert p.decide(_sa_ctx(0, 0, stale=0.1)) == 0    # clamped at 0
+    assert p.decide(_sa_ctx(0, 1, stale=1.0)) == 1    # inside band: hold
+    # inside the hysteresis window the signal is ignored
+    assert p.decide(_sa_ctx(3, 1, stale=9.0, last_switch=2,
+                            hysteresis=4)) == 1
+
+
+def test_staleness_aware_no_oscillation_property():
+    """Adversarial alternating staleness (far over / far under the band
+    every update): switches over N updates stay bounded by
+    N / hysteresis (+1) — the ef_feedback anti-flap property."""
+    from commefficient_tpu.control.policy import StalenessAwarePolicy
+
+    H = 5
+    p = StalenessAwarePolicy(Config(**_SA_KW, control_hysteresis=H))
+    rung, last_switch, switches = 1, -1, 0
+    N = 40
+    for step in range(N):
+        stale = 9.0 if step % 2 == 0 else 0.0
+        nxt = p.decide(_sa_ctx(step, rung, stale=stale,
+                               last_switch=last_switch, hysteresis=H))
+        if nxt != rung:
+            switches += 1
+            last_switch = step
+            rung = nxt
+    assert switches <= N // H + 1, (
+        f"{switches} switches in {N} updates under hysteresis {H}"
+    )
+
+
+def test_staleness_aware_retune_moves():
+    """decide_async is one move per decision toward the fill band:
+    backlog over the band grows K; hot staleness sheds concurrency to 1,
+    then shrinks K only while ALSO starved; a fresh fleet restores C up
+    to the configured ceiling; in-band (or signal-less) holds."""
+    from commefficient_tpu.control.policy import StalenessAwarePolicy
+
+    p = StalenessAwarePolicy(Config(**_SA_KW))
+    assert p.decide_async(_sa_ctx(0, 0, stale=1.0, fill=8), 4, 2) == (5, 2)
+    assert p.decide_async(_sa_ctx(0, 0, stale=3.0, fill=2), 4, 2) == (4, 1)
+    assert p.decide_async(_sa_ctx(0, 0, stale=3.0, fill=0), 4, 1) == (3, 1)
+    # stale but neither concurrency to shed nor starvation: hold
+    assert p.decide_async(_sa_ctx(0, 0, stale=3.0, fill=3), 4, 1) == (4, 1)
+    assert p.decide_async(_sa_ctx(0, 0, stale=0.1, fill=2), 4, 1) == (4, 2)
+    assert p.decide_async(_sa_ctx(0, 0, stale=0.1, fill=2), 4, 2) == (4, 2)
+    assert p.decide_async(_sa_ctx(0, 0, stale=1.0, fill=2), 4, 2) == (4, 2)
+    assert p.decide_async(_sa_ctx(0, 0), 4, 2) == (4, 2)  # sync round
+    # backlog over the band but K already at the fleet width: hold, the
+    # buffer cannot absorb more than one contribution per live worker
+    assert p.decide_async(_sa_ctx(0, 0, stale=1.0, fill=20, workers=4),
+                          4, 2) == (4, 2)
+
+
+def test_fixed_policy_async_run_emits_no_retune_scalars():
+    """Capability gating: an asyncfed run under a NON-adaptive policy
+    must not grow control/async_* keys (nor register retune listeners) —
+    its sync/async scalar sets stay comparable run-to-run."""
+    from commefficient_tpu.asyncfed import AsyncFederation
+
+    cfg = Config(mode="true_topk", error_type="virtual", telemetry_level=1,
+                 control_policy="fixed", control_schedule="0-=0",
+                 ladder="k=30,20", async_buffer=4, async_concurrency=2,
+                 **BASE)
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    ctl = build_controller(cfg, sess, num_rounds=4)
+    assert not ctl.policy.ADAPTS_ASYNC
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    ctl.prewarm(sampler, 0.3)
+    eng = AsyncFederation(cfg, sess, sampler, lambda s: 0.3, num_rounds=4)
+    eng.start(0)
+    for _step, _lr, m in eng.epoch_rounds(0, 0):
+        assert "control/async_k" not in m
+        assert "control/retunes" not in m
+    eng.close()
+    assert eng.stats()["retunes_applied"] == 0
+
+
+def test_staleness_aware_engine_retunes_and_blob_roundtrip():
+    """The closed loop end-to-end: a straggler-heavy asyncfed run under
+    staleness_aware walks the ladder (>= 1 rung move), retunes the
+    ENGINE's live (K, C) through the listener (cold window rebuild, the
+    FedBuff trade), carries (K, C) in snapshot_extra for the vault, and
+    round-trips the v3 controller blob exactly."""
+    from commefficient_tpu.asyncfed import AsyncFederation
+
+    cfg = Config(**{**_SA_KW, **BASE, "ladder": "k=30,20",
+                    "async_concurrency": 3, "control_hysteresis": 1,
+                    "control_staleness_hi": 0.6,
+                    "control_staleness_lo": 0.2})
+    ds, params, loss_fn = _setup(cfg.num_clients)
+    sess = FederatedSession(cfg, params, loss_fn)
+    ctl = build_controller(cfg, sess, num_rounds=10)
+    assert ctl is not None and ctl.policy.ADAPTS_ASYNC
+    sampler = FedSampler(ds, num_workers=cfg.num_workers,
+                         local_batch_size=cfg.local_batch_size, seed=1)
+    ctl.prewarm(sampler, 0.3)
+    eng = AsyncFederation(cfg, sess, sampler, lambda s: 0.3, num_rounds=10)
+    eng.start(0)
+    ks, rungs = [], []
+    for _step, _lr, m in eng.epoch_rounds(0, 0):
+        assert np.isfinite(float(m["loss"]))
+        ks.append(m["control/async_k"])
+        rungs.append(m["control/rung"])
+        assert m["control/async_k"] >= 1 and m["control/async_c"] >= 1
+    eng.close()
+    assert ctl.retunes > 0 and len(set(ks)) > 1, (ks, ctl.retunes)
+    assert eng.stats()["retunes_applied"] >= 1
+    assert len(set(rungs)) > 1, f"no ladder walk: {rungs}"
+    assert sess.retrace_sentinel.retraces == 0
+    # the engine's live geometry rides the vault snapshot extras
+    extra = eng.snapshot_extra()
+    assert extra["k"] == eng._k and extra["c"] == eng._c
+    # v3 blob: (K, C, retunes) survive a fresh controller load exactly
+    blob = ctl.state_blob()
+    sess2 = FederatedSession(cfg, params, loss_fn)
+    ctl2 = build_controller(cfg, sess2, num_rounds=10)
+    ctl2.load_state_blob(blob)
+    assert (ctl2.async_k, ctl2.async_c, ctl2.retunes) == (
+        ctl.async_k, ctl.async_c, ctl.retunes)

@@ -110,7 +110,13 @@ def test_sketch_and_estimate_match_einsum(family, d, c, r, m):
     assert_close(estimate_all(spec_e, te), estimate_all(spec_p, te))
 
 
-@pytest.mark.parametrize("family", ["fmix32", "poly4"])
+@pytest.mark.parametrize("family", [
+    # fmix32 roundtrip rides the slow tier (r20 budget): the family's
+    # pallas==einsum equivalence stays tier-1 via the estimate-match
+    # parametrizations below; poly4 (the default) keeps the roundtrip.
+    pytest.param("fmix32", marks=pytest.mark.slow),
+    "poly4",
+])
 def test_add_linearity_and_unsketch_roundtrip(family):
     spec_e = CountSketch(d=D, c=C, r=R, seed=7, hash_family=family)
     spec_p = spec_e._replace(backend="pallas")

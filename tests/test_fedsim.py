@@ -524,6 +524,11 @@ def _run_dir(tmp_path):
     return runs[0]
 
 
+@pytest.mark.slow  # ~51 s of ResNet-9 cv_main compiles (r20 tier budget);
+# every assertion holds tier-1 siblings: the femnist CLI e2e keeps the
+# cv_main surface, test_resilience pins nan_client divergence + flight at
+# TinyMLP scale, and test_fleet's shrink twin pins the ledger exactness
+# invariant over the ENTIRE comm_ledger.json
 def test_cv_train_dropout_nan_client_ledger_and_flight(tmp_path):
     """One bernoulli@0.3 cv_train run under chaos, covering the whole
     observable surface in a single ResNet-9 compile (tier-1 budget):

@@ -72,6 +72,9 @@ def test_gpt2_train_e2e_sketch_trains(tmp_path):
 # ~14 s standalone (gpt2_tiny, 1 epoch, 2 depths): pins the SECOND
 # workload entry's pipeline wiring through the shared runner; the full
 # bit-exactness contract holds deeper coverage in tests/test_pipeline.py
+@pytest.mark.slow  # r20 tier budget: the depth-0 twin here is the only
+# unique surface (gpt2 entry x pipeline flag plumbing); the contract
+# itself stays tier-1 in test_pipeline's TinyMLP runner pins
 def test_gpt2_train_pipelined_depth2_matches_depth0(tmp_path):
     """gpt2_train.train_loop at --pipeline_depth 2 == depth 0 bitwise
     (final params), through the shared runner's engine wiring."""

@@ -103,6 +103,9 @@ def test_gpt2_double_heads_shapes_and_loss():
     assert float(jnp.abs(g).sum()) > 0
 
 
+@pytest.mark.slow  # r20 tier budget (~20 s of width-8 ResNet-9 grads):
+# the bf16/f32 agreement contract also rides tier-1 through the
+# compressed-path bf16 composition pins (sketch tables, overlap)
 def test_compute_dtype_modes():
     """The three compute modes are genuinely different graphs that agree
     to bf16 resolution: "float32" (module dtype f32, true f32 compute)

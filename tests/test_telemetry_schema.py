@@ -1146,3 +1146,113 @@ def test_v12_perf_report_multihost_block_required_and_forbidden(tmp_path):
     # forbidden direction: the block riding a single-host report
     tampered(lambda r: r["meta"]["config"].update(num_hosts=1),
              "mislabeled producer")
+
+
+# ---------------------------------------------------------------------------
+# v13: fleet/* + control/async_* (elastic fleet / staleness_aware)
+# ---------------------------------------------------------------------------
+
+def test_v13_fleet_scalars_validate_and_reject(tmp_path):
+    """The fleet/ prefix is in-schema through the REAL writer (the
+    end-to-end form — these scalars riding a real elastic run — is
+    pinned by tests/test_fleet.py); the positive-width, counted-event
+    and no-resize-from-the-future invariants reject tampering."""
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, num_workers=8,
+                 num_devices=4, chaos="resize@4:rounds=1-2")
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s, (w, n, last) in enumerate([(8, 0, -1), (4, 1, 1), (4, 1, 1)]):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("fleet/width", float(w), s)
+        writer.scalar("fleet/resizes", float(n), s)
+        writer.scalar("fleet/last_resize_round", float(last), s)
+        writer.scalar("fleet/shrink_recoveries", 0.0, s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    assert mod.validate_metrics_jsonl(path) == 18
+    header = open(path).readline()
+    for bad_rec, msg in [
+        ({"name": "fleet/width", "value": 0.0, "step": 0, "t": 1.0},
+         "positive integer"),
+        ({"name": "fleet/width", "value": 4.5, "step": 0, "t": 1.0},
+         "positive integer"),
+        ({"name": "fleet/resizes", "value": -1.0, "step": 0, "t": 1.0},
+         "non-negative integer"),
+        ({"name": "fleet/shrink_recoveries", "value": 0.5, "step": 0,
+          "t": 1.0}, "non-negative integer"),
+        ({"name": "fleet/last_resize_round", "value": -2.0, "step": 0,
+          "t": 1.0}, ">= -1"),
+        # a resize cannot postdate the round reporting it
+        ({"name": "fleet/last_resize_round", "value": 5.0, "step": 2,
+          "t": 1.0}, "postdates"),
+        ({"name": "fleet/width", "value": "nan", "step": 0, "t": 1.0},
+         "finite number"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(header + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def test_v13_control_async_scalars_validate_and_reject(tmp_path):
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1)
+    run_dir = str(tmp_path / "run")
+    writer = MetricsWriter(run_dir, cfg=cfg)
+    for s in range(2):
+        writer.scalar("train/loss", 1.0, s)
+        writer.scalar("lr", 0.1, s)
+        writer.scalar("control/async_k", 4.0, s)
+        writer.scalar("control/async_c", float(2 - s), s)
+        writer.scalar("control/retunes", float(s), s)
+    writer.close()
+    path = os.path.join(run_dir, "metrics.jsonl")
+    with pytest.raises(mod.SchemaError, match="K >= 1, C >= 1"):
+        # the controller clamps C >= 1: the s=1 row above wrote 1.0, so
+        # tamper a 0 to prove the rule bites
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(open(path).readline() + json.dumps(
+            {"name": "control/async_c", "value": 0.0, "step": 0,
+             "t": 1.0}) + "\n")
+        mod.validate_metrics_jsonl(str(bad))
+    assert mod.validate_metrics_jsonl(path) == 10
+    for bad_rec, msg in [
+        ({"name": "control/async_k", "value": 0.0, "step": 0, "t": 1.0},
+         "K >= 1"),
+        ({"name": "control/async_k", "value": 2.5, "step": 0, "t": 1.0},
+         "positive integer"),
+        ({"name": "control/retunes", "value": -1.0, "step": 0, "t": 1.0},
+         "non-negative"),
+    ]:
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text(open(path).readline() + json.dumps(bad_rec) + "\n")
+        with pytest.raises(mod.SchemaError, match=msg):
+            mod.validate_metrics_jsonl(str(bad))
+
+
+def test_v13_flight_fleet_resizes_monotone(tmp_path):
+    """Flight-ring rule: fleet/resizes is a cumulative transition count,
+    so within one dump's step-ordered records it may never fall — a fall
+    means rolled-back records were spliced into the ring."""
+    from commefficient_tpu.telemetry import FlightRecorder
+
+    mod = _checker()
+    cfg = Config(mode="uncompressed", telemetry_level=1, num_workers=8,
+                 num_devices=4, chaos="resize@4:rounds=1-2")
+    good = FlightRecorder(cfg, logdir=str(tmp_path))
+    for s, n in enumerate([0.0, 1.0, 1.0, 2.0]):
+        good.record(s, 0.1, {"loss": 1.0, "fleet/width": 8.0,
+                             "fleet/resizes": n,
+                             "fleet/last_resize_round": -1.0})
+    path = good.dump(3, reason="ok", first_bad_step=3)
+    mod.validate_flight(path)
+    bad = FlightRecorder(cfg, logdir=str(tmp_path / "bad"))
+    for s, n in enumerate([0.0, 1.0, 0.0]):
+        bad.record(s, 0.1, {"loss": 1.0, "fleet/width": 8.0,
+                            "fleet/resizes": n,
+                            "fleet/last_resize_round": -1.0})
+    path = bad.dump(2, reason="bad", first_bad_step=2)
+    with pytest.raises(mod.SchemaError, match="fell from 1"):
+        mod.validate_flight(path)
